@@ -1,0 +1,31 @@
+"""TPU-native distributed-systems testing framework.
+
+A brand-new framework with the capabilities of jabolina/jepsen-jgroups-raft
+(reference mounted at /root/reference): deploy a Raft-replicated state machine
+onto a cluster, drive concurrent client operations under fault injection,
+record a timestamped operation history with a definite/indefinite error
+taxonomy, and verify the history for linearizability.
+
+The defining difference from the reference: history verification — the
+Knossos WGL/linear search (reference L0 dependency, SURVEY.md §3.4) — runs on
+TPU. Histories are packed into int32 event tensors
+(`history.packing`), the search runs as a fixed-shape frontier scan under
+`jax.lax.scan`/`while_loop` (`ops.linear_scan`), and independent histories are
+vmapped/sharded over a device mesh and verified as one batch (`parallel`).
+
+Package layout (mirrors the reference layer map, SURVEY.md §1):
+  history/   op records, error taxonomy, tensor packing      (jepsen.history)
+  models/    cas-register, counter, leader models            (knossos.model)
+  checker/   linearizable / compose / stats / perf / ...     (jepsen.checker)
+  ops/       the TPU frontier-search kernels                 (knossos search)
+  generator/ generator algebra                               (jepsen.generator)
+  client/    client protocol + error taxonomy                (jepsen.client)
+  nemesis/   fault injection packages                        (jepsen.nemesis)
+  control/   remote/local execution, daemon lifecycle        (jepsen.control)
+  workload/  register, counter, election workloads           (src/jepsen/jgroups/workload)
+  core/      test orchestration (run!)                       (jepsen.core)
+  parallel/  device mesh sharding of batched verification    (new, TPU-first)
+  utils/     timeouts, logging, misc                         (jepsen.util)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Checker framework.
+
+Equivalent surface: jepsen.checker as used by the reference —
+compose / perf / stats / unhandled-exceptions / linearizable /
+timeline (reference raft.clj:73-77, register.clj:106-111,
+counter.clj:133-137, leader.clj:81-85) — plus knossos itself, whose
+linear/WGL search is re-implemented here with CPU and TPU backends.
+"""
+
+from .base import Checker, compose, VALID, INVALID, UNKNOWN  # noqa: F401
+from .wgl_cpu import check_encoded_cpu, CpuCheckResult  # noqa: F401
+from .linearizable import LinearizableChecker, check_histories  # noqa: F401

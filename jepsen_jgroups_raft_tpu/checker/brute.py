@@ -1,0 +1,62 @@
+"""Brute-force linearizability oracle (tests only).
+
+Definition-level checker: enumerate every subset of optional (info) ops and
+every permutation of the chosen ops that respects real-time precedence, and
+ask the model whether some order is sequentially legal. Exponential — used
+by the test suite to validate the frontier search (CPU and TPU) on small
+randomized histories. Mirrors the role knossos' own tiny golden histories
+play in the reference (raft_test.clj, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Sequence, Union
+
+from ..history.ops import History, Op, pair_ops
+
+
+def check_brute(history: Union[History, Sequence[Op]], model) -> bool:
+    ops = list(history)
+    pos = {id(op): i for i, op in enumerate(ops)}
+    items = []  # (inv_pos, res_pos, f, a, b, forced)
+    for pair in pair_ops(ops):
+        enc = model.encode_pair(pair)
+        if enc is None:
+            continue
+        inv = pos[id(pair.invoke)]
+        res = pos[id(pair.completion)] if enc.forced else float("inf")
+        items.append((inv, res, enc))
+
+    forced = [it for it in items if it[2].forced]
+    optional = [it for it in items if not it[2].forced]
+
+    for r in range(len(optional) + 1):
+        for chosen in combinations(optional, r):
+            if _search(forced + list(chosen), model):
+                return True
+    return False
+
+
+def _search(items, model) -> bool:
+    """DFS over precedence-respecting permutations with model pruning."""
+
+    n = len(items)
+    if n == 0:
+        return True
+
+    def rec(remaining: frozenset, state) -> bool:
+        if not remaining:
+            return True
+        for i in remaining:
+            inv_i = items[i][0]
+            # i may come next only if no remaining j finished before i began
+            if any(items[j][1] < inv_i for j in remaining if j != i):
+                continue
+            e = items[i][2]
+            state2, legal = model.step(state, e.f, e.a, e.b)
+            if legal and rec(remaining - {i}, state2):
+                return True
+        return False
+
+    return rec(frozenset(range(n)), model.init_state())

@@ -1,0 +1,28 @@
+"""Operation history: op records, pairing, and tensor packing.
+
+Equivalent surface: jepsen's per-op history records
+`{:process :type :f :value :time :index}` (reference
+test/jepsen/jgroups/raft_test.clj:9-25 shows the shape), plus the
+tensor-packing path that BASELINE.json's north star adds on top.
+"""
+
+from .ops import (  # noqa: F401
+    INVOKE,
+    OK,
+    FAIL,
+    INFO,
+    NEMESIS,
+    Op,
+    History,
+    invoke_op,
+    pair_ops,
+)
+from .packing import (  # noqa: F401
+    EV_PAD,
+    EV_OPEN,
+    EV_FORCE,
+    NIL,
+    EncodedHistory,
+    encode_history,
+    pack_batch,
+)

@@ -1,0 +1,160 @@
+"""Pack an operation history into a fixed-shape int32 event tensor.
+
+This is the "tensor-packing path" of the north star (BASELINE.json): the
+bridge between jepsen-style histories and the on-device frontier search.
+
+Key design decision (TPU-first): instead of shipping raw (invoke, complete)
+interval pairs to the device, the host compiles the history into a compact
+**event stream** the kernel can scan with fixed shapes:
+
+  OPEN  slot f a b   — an op becomes available for linearization. The op is
+                       assigned a *slot*: a position in a sliding window of
+                       at most W concurrently-open ops. Slots of completed
+                       (ok) ops are recycled; crashed (info) ops hold their
+                       slot forever (they remain linearization candidates
+                       until the end — reference doc/intro.md:35-41 names
+                       exactly this as the checker-pressure problem).
+  FORCE slot         — the op in `slot` completed ok: every surviving
+                       search configuration must have linearized it by now.
+
+A search configuration is then just (uint32 bitmask over W slots, int32
+model state) — fixed width, dedupable by sort, vmappable. The algorithm is
+the Wing&Gong/Lowe linear search reshaped for SIMD: closure-expansion of the
+frontier needs to run only at FORCE events, because between two completions
+every open op is mutually concurrent (no real-time edge can appear without a
+completion), so deferring expansion to the next FORCE reaches the identical
+configuration set.
+
+`fail` completions are dropped before packing (the op never executed), and
+idempotent info ops were dropped by the model encoding — mirroring the
+reference's error taxonomy (workload/client.clj:52-63).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .ops import NIL, History, Op, OpPair, pair_ops  # noqa: F401  (NIL re-exported)
+
+# Event types.
+EV_PAD = 0
+EV_OPEN = 1
+EV_FORCE = 2
+
+
+@dataclass
+class EncodedHistory:
+    """A packed history ready for the checker kernels.
+
+    events:   [E, 5] int32 rows (etype, slot, f, a, b)
+    op_index: [E]    int32 original history index of the op behind each
+                     event (-1 for padding) — for counterexample reporting.
+    n_slots:  width of the concurrency window actually used.
+    n_ops:    number of encoded (non-dropped) ops.
+    """
+
+    events: np.ndarray
+    op_index: np.ndarray
+    n_slots: int
+    n_ops: int
+
+    @property
+    def n_events(self) -> int:
+        return int(self.events.shape[0])
+
+
+def encode_history(
+    history: Union[History, Sequence[Op]],
+    model,
+) -> EncodedHistory:
+    """Compile a history into the event-stream representation.
+
+    The model provides per-pair encoding (opcode, args, forced?) via
+    ``model.encode_pair``; this function owns slot assignment and event
+    ordering. Real-time order is the order of ops in the history.
+    """
+
+    ops = list(history)
+    pairs = pair_ops(ops)
+
+    # Encode pairs; remember, per original-op position, what happens there.
+    opens: dict = {}  # invoke position -> (pair, encoded)
+    forces: dict = {}  # completion position -> invoke position
+    pos = {id(op): i for i, op in enumerate(ops)}
+    for pair in pairs:
+        enc = model.encode_pair(pair)
+        if enc is None:
+            continue
+        ip = pos[id(pair.invoke)]
+        opens[ip] = (pair, enc)
+        if enc.forced:
+            forces[pos[id(pair.completion)]] = ip
+
+    rows: List[tuple] = []
+    op_idx: List[int] = []
+    free: List[int] = []  # min-heap of recyclable slots
+    next_slot = 0
+    slot_of: dict = {}  # invoke position -> slot
+    for i, op in enumerate(ops):
+        if i in opens:
+            pair, enc = opens[i]
+            if free:
+                slot = heapq.heappop(free)
+            else:
+                slot = next_slot
+                next_slot += 1
+            slot_of[i] = slot
+            rows.append((EV_OPEN, slot, enc.f, enc.a, enc.b))
+            op_idx.append(op.index if op.index >= 0 else i)
+        elif i in forces:
+            slot = slot_of[forces[i]]
+            rows.append((EV_FORCE, slot, 0, 0, 0))
+            op_idx.append(op.index if op.index >= 0 else i)
+            heapq.heappush(free, slot)
+
+    events = np.asarray(rows, dtype=np.int32).reshape(-1, 5)
+    return EncodedHistory(
+        events=events,
+        op_index=np.asarray(op_idx, dtype=np.int32),
+        n_slots=next_slot,
+        n_ops=len(opens),
+    )
+
+
+def pack_batch(
+    encoded: Iterable[EncodedHistory],
+    n_events: Optional[int] = None,
+) -> dict:
+    """Pad a batch of encoded histories to a common event length.
+
+    Returns numpy arrays: events [B, E, 5], op_index [B, E],
+    n_events [B], n_slots [B]. Padding rows are EV_PAD (no-ops in the
+    kernel scan), so histories of different lengths batch cleanly.
+    """
+
+    encs = list(encoded)
+    if not encs:
+        raise ValueError("empty batch")
+    E = n_events or max(e.n_events for e in encs)
+    if any(e.n_events > E for e in encs):
+        raise ValueError("n_events smaller than longest history")
+    B = len(encs)
+    events = np.zeros((B, E, 5), dtype=np.int32)
+    op_index = np.full((B, E), -1, dtype=np.int32)
+    ne = np.zeros((B,), dtype=np.int32)
+    ns = np.zeros((B,), dtype=np.int32)
+    for i, e in enumerate(encs):
+        events[i, : e.n_events] = e.events
+        op_index[i, : e.n_events] = e.op_index
+        ne[i] = e.n_events
+        ns[i] = e.n_slots
+    return {
+        "events": events,
+        "op_index": op_index,
+        "n_events": ne,
+        "n_slots": ns,
+    }

@@ -1,0 +1,95 @@
+"""Model protocol for the linearizability checker.
+
+A model is a sequential state machine. The checker asks one question: "is
+this operation, with this observed result, legal in this state — and what is
+the state afterwards?" (knossos.model/Model semantics, reference L0).
+
+To run on TPU, models are constrained to:
+  * int32 state (one scalar; richer models pack their state into 32 bits),
+  * a small integer op code ``f`` plus two int32 arguments ``a``/``b``,
+  * a branch-free vectorized JAX step (pure jnp where-math, no data-dependent
+    control flow) so the kernel can evaluate every (configuration, candidate
+    op) pair in one shot on the VPU.
+
+``encode_pair`` is the bridge from history op pairs to kernel ops. It also
+owns the completion-type semantics (reference workload/client.clj:52-63 and
+counter.clj:113-127):
+  * ``fail``  completions are dropped — the op never happened.
+  * ``ok``    completions are *forced* — they must linearize before their
+              completion event.
+  * ``info``  completions (and crashed invokes) are *optional* — they may
+              linearize at any point from invocation onward, or never.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..history.ops import FAIL, INFO, NIL, OK, OpPair
+
+INT32_MIN = -(2**31)
+INT32_MAX = 2**31 - 1
+
+
+def _i32(x) -> int:
+    """Clamp a python int into int32 range (values outside are out of model
+    range anyway; clamping keeps packing total)."""
+    if x is None:
+        return NIL
+    x = int(x)
+    return max(INT32_MIN, min(INT32_MAX, x))
+
+
+@dataclass(frozen=True)
+class EncodedOp:
+    """A kernel-ready op: opcode + two int32 args + whether its completion
+    forces linearization (ok) or leaves it optional forever (info)."""
+
+    f: int
+    a: int
+    b: int
+    forced: bool
+
+
+class Model:
+    """Base class; subclasses define opcodes, steps, and history encoding."""
+
+    name: str = "abstract"
+
+    def init_state(self) -> int:
+        raise NotImplementedError
+
+    def step(self, state: int, f: int, a: int, b: int) -> Tuple[int, bool]:
+        """Pure python step: (state, op) -> (state', legal). Must agree
+        exactly with `jax_step` — the differential tests pin this."""
+        raise NotImplementedError
+
+    def jax_step(self, state, f, a, b):
+        """Vectorized step on jnp arrays (broadcasting), -> (state', legal).
+
+        Must be branch-free: called inside the frontier-expansion kernel on
+        a [n_configs, n_slots] grid.
+        """
+        raise NotImplementedError
+
+    def encode_pair(self, pair: OpPair) -> Optional[EncodedOp]:
+        """Encode one invocation/completion pair, or None to drop it."""
+        if pair.ctype == FAIL:
+            return None
+        return self._encode(pair)
+
+    def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
+        raise NotImplementedError
+
+    # -- conveniences -----------------------------------------------------
+
+    def run_sequential(self, encoded_ops) -> bool:
+        """Apply ops in order; True iff every step is legal. (Test helper &
+        sequential-consistency fast path.)"""
+        state = self.init_state()
+        for e in encoded_ops:
+            state, legal = self.step(state, e.f, e.a, e.b)
+            if not legal:
+                return False
+        return True

@@ -1,0 +1,86 @@
+"""Replicated counter model.
+
+Equivalent of the reference's hand-written CounterModel
+(workload/counter.clj:100-127): ops are read ("get"), add (delta, including
+negative deltas — the client maps decrement onto a negated add,
+counter.clj:56-59), and add-and-get (delta plus the observed new value).
+
+Semantics pinned by the reference's unit tests (raft_test.clj, SURVEY.md §4):
+  * a completed add-and-get requires ``state + delta == observed``
+    (counter.clj:113-127);
+  * an ``info`` add/add-and-get may or may not have applied. The reference
+    model "optimistically applies the delta" for info ops; in this framework
+    the same semantics falls out of the search — info ops are *optional*
+    linearization candidates, and an info add-and-get's return value is
+    unconstrained, i.e. it degrades to a plain add.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..history.ops import OK, OpPair
+from .base import EncodedOp, Model, _i32
+
+READ = 0
+ADD = 1
+ADD_AND_GET = 2
+
+
+class Counter(Model):
+    name = "counter"
+    n_fcodes = 3
+
+    def __init__(self, initial: int = 0):
+        self.initial = _i32(initial)
+
+    def init_state(self) -> int:
+        return self.initial
+
+    def step(self, state, f, a, b):
+        if f == READ:
+            return state, state == a
+        if f == ADD:
+            return _wrap32(state + a), True
+        if f == ADD_AND_GET:
+            new = _wrap32(state + a)
+            return new, new == b
+        raise ValueError(f"bad opcode {f}")
+
+    def jax_step(self, state, f, a, b):
+        added = state + a  # int32 wraparound matches _wrap32
+        legal = (f == ADD) | ((f == READ) & (state == a)) | (
+            (f == ADD_AND_GET) & (added == b)
+        )
+        new_state = jnp.where(f == READ, state, added)
+        return new_state, legal
+
+    def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
+        f = pair.f
+        forced = pair.ctype == OK
+        # decrement ops are adds of the negated delta (counter.clj:56-59)
+        sign = -1 if f in ("decr", "decr-and-get") else 1
+        if f in ("read", "get"):
+            if not forced:
+                return None
+            return EncodedOp(READ, _i32(pair.completion.value), 0, True)
+        if f in ("add", "decr"):
+            return EncodedOp(ADD, sign * _i32(pair.invoke.value), 0, forced)
+        if f in ("add-and-get", "decr-and-get"):
+            if forced:
+                # completed value is [delta, new] (counter.clj:113-127)
+                delta, new = pair.completion.value
+                return EncodedOp(
+                    ADD_AND_GET, sign * _i32(delta), _i32(new), True
+                )
+            # unknown result: constrains nothing beyond the delta
+            return EncodedOp(ADD, sign * _i32(pair.invoke.value), 0, False)
+        raise ValueError(f"counter: unknown op f={f!r}")
+
+
+def _wrap32(x: int) -> int:
+    """Two's-complement int32 wraparound, matching jnp.int32 arithmetic."""
+    x &= 0xFFFFFFFF
+    return x - (1 << 32) if x >= (1 << 31) else x

@@ -1,0 +1,87 @@
+"""Election-safety model.
+
+Equivalent of the reference's LeaderModel (workload/leader.clj:63-75): each
+``inspect`` op observes a ``(leader, term)`` tuple; the invariant is that no
+term ever has two different leaders ("election safety"). Like the reference
+(comment at leader.clj:58-62) it does NOT check majority agreement.
+
+This invariant is order-independent — no linearization search is needed —
+so it gets a direct vectorized check rather than the frontier kernel:
+sort observations by term and compare adjacent same-term leaders.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..history.ops import OK, History, Op
+
+
+class LeaderModel:
+    """Checks election safety over inspect observations."""
+
+    name = "leader"
+
+    def observations(self, history: History) -> np.ndarray:
+        """Extract [(term, leader_id)] int32 pairs from ok inspect ops.
+
+        Leaders are interned to dense int ids (node names are strings).
+        """
+        self._leaders: dict = {}
+        rows = []
+        for op in history:
+            if op.type == OK and op.f == "inspect":
+                leader, term = op.value
+                if leader is None:
+                    continue  # no leader known at inspection time
+                lid = self._leaders.setdefault(leader, len(self._leaders))
+                rows.append((int(term), lid))
+        return np.asarray(rows, dtype=np.int32).reshape(-1, 2)
+
+    def check(self, history: History) -> dict:
+        obs = self.observations(history)
+        valid, bad_term = check_election_safety_np(obs)
+        result = {"valid?": bool(valid), "observation-count": int(len(obs))}
+        if not valid:
+            by_id = {v: k for k, v in self._leaders.items()}
+            leaders = sorted(
+                {by_id[int(l)] for t, l in obs if int(t) == bad_term}
+            )
+            result["error"] = (
+                f"two leaders observed for term {bad_term}: {leaders}"
+            )
+            result["term"] = int(bad_term)
+        return result
+
+
+def check_election_safety_np(obs: np.ndarray) -> Tuple[bool, Optional[int]]:
+    """(valid?, first offending term). obs: [N,2] int32 (term, leader)."""
+    if len(obs) == 0:
+        return True, None
+    order = np.lexsort((obs[:, 1], obs[:, 0]))
+    s = obs[order]
+    same_term = s[1:, 0] == s[:-1, 0]
+    diff_leader = s[1:, 1] != s[:-1, 1]
+    bad = same_term & diff_leader
+    if bad.any():
+        return False, int(s[1:][bad][0, 0])
+    return True, None
+
+
+def check_election_safety_jax(obs):
+    """Batched/jittable variant: obs [N,2] int32 (padded rows = -1 term).
+
+    Returns a bool scalar. Sorts by (term, leader) and checks adjacency;
+    padding terms of -1 are allowed to repeat by also padding leader = -1.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    term, leader = obs[:, 0], obs[:, 1]
+    ts, ls = lax.sort((term, leader), num_keys=2)
+    same_term = ts[1:] == ts[:-1]
+    diff_leader = ls[1:] != ls[:-1]
+    real = ts[1:] >= 0
+    return ~jnp.any(same_term & diff_leader & real)

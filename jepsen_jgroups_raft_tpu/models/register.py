@@ -1,0 +1,76 @@
+"""Compare-and-set register model.
+
+Equivalent of knossos.model/cas-register as used by the reference's register
+workload (reference workload/register.clj:106-111): ops are read / write /
+cas over a single register whose initial value is nil.
+
+Completion semantics mirror the reference client:
+  * reads are idempotent, so indefinite failures were already turned into
+    ``fail`` by the error taxonomy (register.clj:72) — an info read carries
+    no constraint and is dropped here too;
+  * a CAS that returned false is recorded ``fail`` ``:cas-fail``
+    (register.clj:82-84) and dropped — it never mutated the register;
+  * info writes/cas may or may not have applied: optional ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..history.ops import OK, OpPair
+from .base import NIL, EncodedOp, Model, _i32
+
+READ = 0
+WRITE = 1
+CAS = 2
+
+F_NAMES = {"read": READ, "write": WRITE, "cas": CAS}
+
+
+class CasRegister(Model):
+    name = "cas-register"
+    n_fcodes = 3
+
+    def __init__(self, initial: Optional[int] = None):
+        self.initial = NIL if initial is None else _i32(initial)
+
+    def init_state(self) -> int:
+        return self.initial
+
+    def step(self, state, f, a, b):
+        if f == READ:
+            return state, state == a
+        if f == WRITE:
+            return a, True
+        if f == CAS:
+            if state == a:
+                return b, True
+            return state, False
+        raise ValueError(f"bad opcode {f}")
+
+    def jax_step(self, state, f, a, b):
+        is_write = f == WRITE
+        is_cas = f == CAS
+        match = state == a
+        legal = is_write | match  # read/cas legal iff observed/from matches
+        new_state = jnp.where(
+            is_write, a, jnp.where(is_cas & match, b, state)
+        )
+        return new_state, legal
+
+    def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
+        f = pair.f
+        forced = pair.ctype == OK
+        if f == "read":
+            if not forced:
+                return None  # unknown read constrains nothing
+            value = pair.completion.value
+            return EncodedOp(READ, _i32(value), 0, True)
+        if f == "write":
+            return EncodedOp(WRITE, _i32(pair.invoke.value), 0, forced)
+        if f == "cas":
+            frm, to = pair.invoke.value
+            return EncodedOp(CAS, _i32(frm), _i32(to), forced)
+        raise ValueError(f"cas-register: unknown op f={f!r}")

@@ -1,0 +1,14 @@
+"""On-device kernels: the TPU execution backend for history verification.
+
+This package is the equivalent of knossos' search engine (the reference's
+L0 "compute kernel", SURVEY.md §3.4), re-designed for XLA/TPU: fixed-shape
+frontier expansion under lax.scan/while_loop, sort-based deduplication,
+vmap over batches of independent histories.
+"""
+
+from .linear_scan import (  # noqa: F401
+    make_batch_checker,
+    make_history_checker,
+    DEFAULT_N_CONFIGS,
+    MAX_SLOTS,
+)

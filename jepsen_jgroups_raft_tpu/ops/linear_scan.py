@@ -1,0 +1,196 @@
+"""TPU frontier-search kernel for linearizability checking.
+
+The Wing&Gong/Lowe linear search (knossos' :linear algorithm — the
+reference's checker engine, register.clj:110-111 / SURVEY.md §3.4) recast as
+a fixed-shape scan that XLA compiles onto the TPU vector unit:
+
+  * A search **configuration** is (uint32 bitmask over ≤32 concurrency-window
+    slots, int32 model state). The frontier is a fixed-capacity array of
+    C configurations; empty entries carry a sentinel mask.
+  * The packed event stream (history/packing.py) is scanned with `lax.scan`.
+    OPEN events update per-slot op registers; FORCE events run a closure:
+    expand every configuration by every open un-linearized slot — a single
+    branch-free [C, W] evaluation of the model's vectorized step — then
+    deduplicate by a 2-key `lax.sort` and compact, repeating under
+    `lax.while_loop` until the frontier stops growing.
+  * Dedup-by-sort is the memoization: it plays the role of knossos'
+    visited-configuration hash set, but as a data-parallel primitive with
+    no hashing and no false positives (soundness note in SURVEY.md §7.4.2).
+  * Configurations that fail to linearize a FORCEd op are killed; an empty
+    frontier ⇒ not linearizable. Frontier overflow (more than C distinct
+    configurations) is reported, never silently dropped: the caller escalates
+    to a bigger kernel or the unbounded CPU twin (checker/wgl_cpu.py).
+  * `vmap` lifts everything over a batch of histories; `parallel/` shards
+    the batch over the device mesh.
+
+Why closure only at FORCE events is sound: between two completions no
+real-time precedence edge can appear (all open ops are mutually concurrent),
+so deferring expansion from OPEN events to the next FORCE reaches the
+identical configuration set — see history/packing.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..history.packing import EV_FORCE, EV_OPEN
+
+#: Hard window cap: masks are uint32, and bit 31 is reserved so that a
+#: fully-linearized 31-slot mask can never equal the all-ones empty-entry
+#: sentinel (a 32-slot config with every bit set WOULD collide with _SENT
+#: and be silently dropped — a soundness hole). Histories needing more
+#: concurrent slots (incl. never-retiring info ops) fall back to the CPU
+#: checker, whose masks are arbitrary-precision.
+MAX_SLOTS = 31
+
+DEFAULT_N_CONFIGS = 256
+
+_SENT = jnp.uint32(0xFFFFFFFF)  # empty-frontier-entry sentinel mask
+
+
+def _dedup_compact(masks, states, n_configs):
+    """Sort (mask, state) pairs, drop duplicates & sentinels, compact the
+    first n_configs into a fresh frontier. Returns (masks', states', count,
+    overflowed)."""
+    sm, ss = lax.sort((masks, states), num_keys=2)
+    first = jnp.concatenate([jnp.array([True]), (sm[1:] != sm[:-1]) | (ss[1:] != ss[:-1])])
+    keep = first & (sm != _SENT)
+    pos = jnp.cumsum(keep) - 1
+    count = jnp.sum(keep)
+    overflow = count > n_configs
+    idx = jnp.where(keep & (pos < n_configs), pos, n_configs)
+    out_m = jnp.full((n_configs,), _SENT, dtype=jnp.uint32).at[idx].set(sm, mode="drop")
+    out_s = jnp.zeros((n_configs,), dtype=jnp.int32).at[idx].set(ss, mode="drop")
+    return out_m, out_s, jnp.minimum(count, n_configs), overflow
+
+
+def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
+                         n_slots: int = MAX_SLOTS):
+    """Build a jittable single-history checker.
+
+    Returns fn(events:[E,5] int32) -> (valid: bool, overflow: bool).
+    `model` supplies the vectorized `jax_step` and initial state; `n_configs`
+    (C) and `n_slots` (W ≤ 32) fix the kernel shape.
+    """
+    if n_slots > MAX_SLOTS:
+        raise ValueError(f"n_slots {n_slots} > {MAX_SLOTS}")
+    C, W = int(n_configs), int(n_slots)
+    init_state = jnp.int32(model.init_state())
+    slot_ids = jnp.arange(W, dtype=jnp.int32)
+    slot_bits = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))  # [W]
+
+    def expand_once(masks, states, count, overflow, slot_f, slot_a, slot_b,
+                    slot_open):
+        live = masks != _SENT  # [C]
+        m = masks[:, None]  # [C,1]
+        s = states[:, None]
+        candidate_open = slot_open[None, :] & ((m & slot_bits[None, :]) == 0)
+        ns, legal = model.jax_step(s, slot_f[None, :], slot_a[None, :],
+                                   slot_b[None, :])
+        good = live[:, None] & candidate_open & legal  # [C,W]
+        cand_m = jnp.where(good, m | slot_bits[None, :], _SENT)
+        cand_s = jnp.where(good, ns, 0).astype(jnp.int32)
+        all_m = jnp.concatenate([masks, cand_m.reshape(-1)])
+        all_s = jnp.concatenate([states, cand_s.reshape(-1)])
+        nm, nstates, ncount, of = _dedup_compact(all_m, all_s, C)
+        return nm, nstates, ncount, overflow | of
+
+    def closure(masks, states, count, overflow, slot_f, slot_a, slot_b,
+                slot_open, active):
+        # Fixed point: each round adds ≥1 bit to some mask or stops, so at
+        # most W productive rounds; `active` short-circuits non-FORCE events
+        # (the while body never runs for them).
+        def cond(c):
+            return c[0]
+
+        def body(c):
+            _, it, masks, states, count, overflow = c
+            nm, ns, ncount, nof = expand_once(masks, states, count, overflow,
+                                              slot_f, slot_a, slot_b,
+                                              slot_open)
+            grew = ncount > count
+            return (grew & (it < W), it + 1, nm, ns, ncount, nof)
+
+        _, _, masks, states, count, overflow = lax.while_loop(
+            cond, body, (active, jnp.int32(0), masks, states, count, overflow)
+        )
+        return masks, states, count, overflow
+
+    def scan_step(carry, ev):
+        masks, states, count, slot_f, slot_a, slot_b, slot_open, ok, overflow = carry
+        etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+        is_open = etype == EV_OPEN
+        is_force = etype == EV_FORCE
+
+        onehot = slot_ids == slot  # [W]
+        upd = onehot & is_open
+        slot_f = jnp.where(upd, f, slot_f)
+        slot_a = jnp.where(upd, a, slot_a)
+        slot_b = jnp.where(upd, b, slot_b)
+        slot_open = jnp.where(upd, True, slot_open)
+
+        masks, states, count, overflow = closure(
+            masks, states, count, overflow, slot_f, slot_a, slot_b,
+            slot_open, is_force)
+
+        # FORCE: survivors have the slot's bit; then the bit is recycled.
+        # Liveness guard matters: sentinel entries have every bit set and
+        # must not masquerade as survivors.
+        bit = jnp.uint32(1) << slot.astype(jnp.uint32)
+        live = masks != _SENT
+        has = ((masks & bit) != 0) & live
+        killed_m = jnp.where(is_force & live & ~has, _SENT, masks)
+        cleared_m = jnp.where(is_force & has, killed_m & ~bit, killed_m)
+        alive = jnp.any(cleared_m != _SENT)
+        ok = ok & (~is_force | alive)
+        slot_open = slot_open & ~(onehot & is_force)
+        # Clearing the recycled bit can merge configurations; re-dedup so the
+        # next closure's grew-by-count fixpoint test stays exact. (Idempotent
+        # and cheap for non-FORCE events: one C-element sort.)
+        masks, states, count, _ = _dedup_compact(cleared_m, states, C)
+        return (masks, states, count, slot_f, slot_a, slot_b, slot_open,
+                ok, overflow), None
+
+    def check(events):
+        masks = jnp.full((C,), _SENT, dtype=jnp.uint32).at[0].set(jnp.uint32(0))
+        states = jnp.zeros((C,), dtype=jnp.int32).at[0].set(init_state)
+        carry = (
+            masks, states, jnp.int32(1),
+            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
+            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
+            jnp.bool_(True), jnp.bool_(False),
+        )
+        carry, _ = lax.scan(scan_step, carry, events)
+        ok, overflow = carry[7], carry[8]
+        # An overflowed run may have dropped configurations: a "False" can
+        # be a false negative, so report unknown instead (caller escalates).
+        return ok, overflow
+
+    return check
+
+
+_KERNEL_CACHE: dict = {}
+
+
+def make_batch_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
+                       n_slots: int = MAX_SLOTS, jit: bool = True):
+    """vmapped batch variant: fn(events:[B,E,5]) -> (valid[B], overflow[B]).
+
+    Kernels are cached by (model identity, C, W): jax.jit caches traces per
+    function object, so handing it a fresh closure per call would recompile
+    every time. Model identity = (class, init_state), which fully determines
+    the kernel — jax_step is class-level code.
+    """
+    key = (type(model), model.init_state(), int(n_configs), int(n_slots), jit)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        single = make_history_checker(model, n_configs, n_slots)
+        fn = jax.vmap(single)
+        if jit:
+            fn = jax.jit(fn)
+        _KERNEL_CACHE[key] = fn
+    return fn

@@ -1,0 +1,26 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh so sharding and
+multi-chip paths are exercised without TPU hardware (the driver separately
+dry-runs multi-chip via __graft_entry__.dryrun_multichip).
+
+Two subtleties:
+  * The TPU plugin (axon) is registered by sitecustomize at interpreter
+    start, which imports jax — so setting JAX_PLATFORMS in os.environ here
+    is too late. Update jax.config directly instead; that keeps the TPU
+    backend from ever initializing (tests must not depend on the TPU
+    tunnel being reachable).
+  * XLA_FLAGS must be set before the CPU backend initializes, which it
+    hasn't at conftest import time.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,283 @@
+"""Checker tests: golden histories (the reference's raft_test.clj strategy —
+tiny adversarial histories through the production checker, SURVEY.md §4),
+plus differential tests of brute-force vs CPU frontier vs TPU kernel."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker.brute import check_brute
+from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
+from jepsen_jgroups_raft_tpu.history.ops import INFO, INVOKE, OK, FAIL
+from jepsen_jgroups_raft_tpu.history.packing import (
+    EV_FORCE,
+    EV_OPEN,
+    encode_history,
+    pack_batch,
+)
+from jepsen_jgroups_raft_tpu.models import CasRegister, Counter
+from jepsen_jgroups_raft_tpu.ops.linear_scan import make_batch_checker
+
+from util import H, corrupt, random_valid_history
+
+
+def cpu_check(hist, model):
+    return check_encoded_cpu(encode_history(hist, model), model).valid
+
+
+def jax_check(hist, model, n_configs=64):
+    enc = encode_history(hist, model)
+    batch = pack_batch([enc])
+    kernel = make_batch_checker(model, n_configs=n_configs, n_slots=8)
+    ok, overflow = kernel(batch["events"])
+    assert not bool(overflow[0]), "unexpected frontier overflow in test"
+    return bool(ok[0])
+
+
+# ---------------------------------------------------------------- golden --
+# Counter goldens mirror the semantics pinned by the reference's unit tests
+# (test/jepsen/jgroups/raft_test.clj via SURVEY.md §4): interleaved ops with
+# an unapplied info op must pass; a stale read must fail; an info op that
+# *was* applied plus a later contradicting read must fail.
+
+
+class TestCounterGoldens:
+    def test_valid_interleaved_with_unapplied_info(self):
+        h = H(
+            (0, INVOKE, "add", 1),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", 0),          # read before the add applied
+            (0, OK, "add", 1),
+            (2, INVOKE, "add", 2),       # crashes: never completes
+            (3, INVOKE, "read", None),
+            (3, OK, "read", 1),          # consistent iff crashed add unapplied
+        )
+        m = Counter()
+        assert check_brute(h, m) is True
+        assert cpu_check(h, m) is True
+        assert jax_check(h, m) is True
+
+    def test_invalid_stale_read(self):
+        h = H(
+            (0, INVOKE, "add", 1),
+            (0, OK, "add", 1),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", 0),          # stale: add already completed
+        )
+        m = Counter()
+        assert check_brute(h, m) is False
+        assert cpu_check(h, m) is False
+        assert jax_check(h, m) is False
+
+    def test_invalid_applied_info_then_contradicting_read(self):
+        h = H(
+            (0, INVOKE, "add", 1),
+            (0, INFO, "add", 1),         # unknown: may have applied
+            (1, INVOKE, "read", None),
+            (1, OK, "read", 1),          # proves it DID apply
+            (2, INVOKE, "read", None),
+            (2, OK, "read", 0),          # ...then contradicts it
+        )
+        m = Counter()
+        assert check_brute(h, m) is False
+        assert cpu_check(h, m) is False
+        assert jax_check(h, m) is False
+
+    def test_add_and_get_constrains(self):
+        h = H(
+            (0, INVOKE, "add-and-get", 2),
+            (0, OK, "add-and-get", (2, 2)),
+            (1, INVOKE, "add-and-get", 3),
+            (1, OK, "add-and-get", (3, 6)),   # should be 5
+        )
+        m = Counter()
+        assert check_brute(h, m) is False
+        assert cpu_check(h, m) is False
+        assert jax_check(h, m) is False
+
+
+class TestRegisterGoldens:
+    def test_read_of_never_written_value(self):
+        h = H(
+            (0, INVOKE, "write", 1),
+            (0, OK, "write", 1),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", 2),
+        )
+        m = CasRegister()
+        assert check_brute(h, m) is False
+        assert cpu_check(h, m) is False
+        assert jax_check(h, m) is False
+
+    def test_concurrent_write_read_either_value_ok(self):
+        for observed in (None, 7):
+            h = H(
+                (0, INVOKE, "write", 7),
+                (1, INVOKE, "read", None),
+                (1, OK, "read", observed),
+                (0, OK, "write", 7),
+            )
+            m = CasRegister()
+            assert check_brute(h, m) is True
+            assert cpu_check(h, m) is True
+            assert jax_check(h, m) is True
+
+    def test_cas_chain(self):
+        h = H(
+            (0, INVOKE, "write", 0),
+            (0, OK, "write", 0),
+            (1, INVOKE, "cas", (0, 3)),
+            (1, OK, "cas", True),
+            (2, INVOKE, "read", None),
+            (2, OK, "read", 3),
+        )
+        m = CasRegister()
+        assert cpu_check(h, m) is True
+        assert jax_check(h, m) is True
+
+    def test_info_write_observed_later_is_valid(self):
+        h = H(
+            (0, INVOKE, "write", 5),
+            (0, INFO, "write", 5),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", 5),
+        )
+        m = CasRegister()
+        assert check_brute(h, m) is True
+        assert cpu_check(h, m) is True
+        assert jax_check(h, m) is True
+
+    def test_info_write_must_not_be_required_twice(self):
+        # info write observed, then old value read again: invalid
+        h = H(
+            (0, INVOKE, "write", 1),
+            (0, OK, "write", 1),
+            (1, INVOKE, "write", 5),
+            (1, INFO, "write", 5),
+            (2, INVOKE, "read", None),
+            (2, OK, "read", 5),
+            (3, INVOKE, "read", None),
+            (3, OK, "read", 1),
+        )
+        m = CasRegister()
+        assert check_brute(h, m) is False
+        assert cpu_check(h, m) is False
+        assert jax_check(h, m) is False
+
+
+# -------------------------------------------------------------- packing --
+
+
+class TestPacking:
+    def test_slot_recycling_and_events(self):
+        h = H(
+            (0, INVOKE, "write", 1),
+            (0, OK, "write", 1),
+            (1, INVOKE, "write", 2),
+            (1, OK, "write", 2),
+        )
+        enc = encode_history(h, CasRegister())
+        # sequential ops share one slot
+        assert enc.n_slots == 1
+        assert enc.events[:, 0].tolist() == [EV_OPEN, EV_FORCE, EV_OPEN, EV_FORCE]
+        assert enc.n_ops == 2
+
+    def test_concurrency_window(self):
+        h = H(
+            (0, INVOKE, "write", 1),
+            (1, INVOKE, "write", 2),
+            (2, INVOKE, "write", 3),
+            (2, OK, "write", 3),
+            (1, OK, "write", 2),
+            (0, OK, "write", 1),
+        )
+        enc = encode_history(h, CasRegister())
+        assert enc.n_slots == 3
+
+    def test_fail_dropped(self):
+        h = H(
+            (0, INVOKE, "cas", (0, 1)),
+            (0, FAIL, "cas", (0, 1)),
+        )
+        enc = encode_history(h, CasRegister())
+        assert enc.n_events == 0
+        assert enc.n_ops == 0
+
+    def test_pack_batch_pads(self):
+        h1 = H((0, INVOKE, "write", 1), (0, OK, "write", 1))
+        h2 = H(
+            (0, INVOKE, "write", 1), (0, OK, "write", 1),
+            (1, INVOKE, "read", None), (1, OK, "read", 1),
+        )
+        m = CasRegister()
+        batch = pack_batch([encode_history(h1, m), encode_history(h2, m)])
+        assert batch["events"].shape == (2, 4, 5)
+        assert batch["n_events"].tolist() == [2, 4]
+        # padding rows are EV_PAD
+        assert batch["events"][0, 2:, 0].tolist() == [0, 0]
+
+
+# --------------------------------------------------------- differential --
+
+
+@pytest.mark.parametrize("model_kind", ["register", "counter"])
+def test_differential_random_histories(model_kind):
+    """brute == cpu == jax on randomized small histories, valid + corrupted."""
+    rng = random.Random(42)
+    model = CasRegister() if model_kind == "register" else Counter()
+    n_mismatch = 0
+    cases = []
+    for trial in range(120):
+        h = random_valid_history(rng, model_kind, n_ops=7, n_procs=3)
+        if trial % 2:
+            h = corrupt(rng, h)
+        cases.append(h)
+    kernel = make_batch_checker(model, n_configs=128, n_slots=8)
+    encs = [encode_history(h, model) for h in cases]
+    nonempty = [i for i, e in enumerate(encs) if e.n_events > 0]
+    batch = pack_batch([encs[i] for i in nonempty])
+    ok, overflow = kernel(batch["events"])
+    ok = np.asarray(ok)
+    assert not np.asarray(overflow).any()
+    jax_verdicts = {i: bool(ok[j]) for j, i in enumerate(nonempty)}
+    for i, h in enumerate(cases):
+        expected = check_brute(h, model)
+        got_cpu = check_encoded_cpu(encs[i], model).valid
+        assert got_cpu == expected, f"cpu mismatch on case {i}"
+        got_jax = jax_verdicts.get(i, True)
+        assert got_jax == expected, f"jax mismatch on case {i}"
+
+
+def test_uncorrupted_random_histories_always_valid():
+    rng = random.Random(7)
+    m = CasRegister()
+    for _ in range(60):
+        h = random_valid_history(rng, "register", n_ops=10, n_procs=4)
+        assert cpu_check(h, m) is True
+
+
+# ------------------------------------------------------------ check API --
+
+
+def test_check_histories_auto_batches_and_falls_back():
+    rng = random.Random(3)
+    m = Counter()
+    hs = [random_valid_history(rng, "counter", n_ops=12, n_procs=4)
+          for _ in range(8)]
+    results = check_histories(hs, m, algorithm="auto")
+    assert all(r["valid?"] is True for r in results)
+    assert any(r["algorithm"] == "jax" for r in results)
+
+
+def test_check_histories_cpu_reports_counterexample():
+    h = H(
+        (0, INVOKE, "add", 1),
+        (0, OK, "add", 1),
+        (1, INVOKE, "read", None),
+        (1, OK, "read", 0),
+    )
+    [r] = check_histories([h], Counter(), algorithm="cpu")
+    assert r["valid?"] is False
+    assert r["failing-op-index"] == 3  # the stale read's completion

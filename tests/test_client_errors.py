@@ -1,0 +1,97 @@
+"""Error-taxonomy tests (reference workload/client.clj:52-63 semantics) and
+regression tests for kernel capacity limits."""
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.client import (
+    ClientTimeout,
+    ConnectFailed,
+    NotLeader,
+    SocketBroken,
+    with_errors,
+)
+from jepsen_jgroups_raft_tpu.history.ops import FAIL, INFO, INVOKE, NEMESIS, OK, Op
+from jepsen_jgroups_raft_tpu.checker import LinearizableChecker
+from jepsen_jgroups_raft_tpu.models import CasRegister
+from jepsen_jgroups_raft_tpu.ops.linear_scan import MAX_SLOTS, make_history_checker
+
+
+def _raising(exc):
+    def invoke(test, op):
+        raise exc
+    return invoke
+
+
+def _op(f="write", value=1):
+    return Op(process=0, type=INVOKE, f=f, value=value)
+
+
+class TestTaxonomy:
+    def test_timeout_is_indefinite(self):
+        out = with_errors(_raising(ClientTimeout("10s")), {}, _op())
+        assert out.type == INFO
+        assert "timeout" in out.error
+
+    def test_timeout_on_idempotent_op_is_definite_fail(self):
+        out = with_errors(_raising(ClientTimeout()), {}, _op("read", None),
+                          idempotent={"read"})
+        assert out.type == FAIL
+
+    def test_connect_refused_is_definite(self):
+        out = with_errors(_raising(ConnectFailed()), {}, _op())
+        assert out.type == FAIL
+        assert "connect" in out.error
+
+    def test_not_leader_is_definite(self):
+        out = with_errors(_raising(NotLeader("I'm not the leader")), {}, _op())
+        assert out.type == FAIL
+        assert "no-leader" in out.error
+
+    def test_socket_is_indefinite(self):
+        out = with_errors(_raising(SocketBroken()), {}, _op())
+        assert out.type == INFO
+
+    def test_non_client_error_propagates(self):
+        with pytest.raises(ZeroDivisionError):
+            with_errors(_raising(ZeroDivisionError()), {}, _op())
+
+    def test_success_passthrough(self):
+        def invoke(test, op):
+            return op.replace(type=OK)
+        assert with_errors(invoke, {}, _op()).type == OK
+
+
+class TestKernelCapacity:
+    def test_kernel_rejects_window_wider_than_31(self):
+        # Bit 31 is reserved: a 32-slot all-linearized mask would equal the
+        # empty-entry sentinel and be dropped — a soundness hole found by
+        # review; the kernel must refuse rather than mis-verdict.
+        with pytest.raises(ValueError):
+            make_history_checker(CasRegister(), n_slots=32)
+        assert MAX_SLOTS == 31
+
+    def test_wide_history_falls_back_to_cpu(self):
+        # 33 concurrent crashed cas ops chained 0->1->...->33 + one ok read:
+        # window exceeds the kernel cap; auto mode must still verify it
+        # (CPU fallback), and the verdict must be valid.
+        rows = []
+        for i in range(33):
+            rows.append(Op(i, INVOKE, "cas", (i, i + 1)))
+        rows.append(Op(100, INVOKE, "read", None))
+        rows.append(Op(100, OK, "read", 5))  # chain linearized up to 5
+        # writes initial value first
+        seed = [Op(200, INVOKE, "write", 0), Op(200, OK, "write", 0)]
+        hist = seed + rows
+        r = LinearizableChecker(CasRegister(), algorithm="auto").check({}, hist)
+        assert r["valid?"] is True
+        assert r["algorithm"] == "cpu"
+
+    def test_nemesis_ops_filtered(self):
+        hist = [
+            Op(NEMESIS, INVOKE, "start-partition", None),
+            Op(0, INVOKE, "write", 1),
+            Op(0, OK, "write", 1),
+            Op(NEMESIS, INFO, "start-partition", "partitioned"),
+        ]
+        r = LinearizableChecker(CasRegister()).check({}, hist)
+        assert r["valid?"] is True

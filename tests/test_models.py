@@ -1,0 +1,104 @@
+"""Model-level tests: python step vs vectorized jax step must agree exactly,
+and encoding must implement the reference's completion-type semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.history.ops import Op, OpPair, INVOKE, OK, FAIL, INFO
+from jepsen_jgroups_raft_tpu.models import CasRegister, Counter, NIL
+from jepsen_jgroups_raft_tpu.models import register as reg
+from jepsen_jgroups_raft_tpu.models import counter as cnt
+
+
+def pair(f, iv, ctype, cv=None, process=0):
+    inv = Op(process, INVOKE, f, iv)
+    comp = None if ctype is None else Op(process, ctype, f, cv)
+    return OpPair(inv, comp)
+
+
+class TestCasRegister:
+    def test_step_semantics(self):
+        m = CasRegister()
+        assert m.init_state() == NIL
+        s, ok = m.step(NIL, reg.WRITE, 3, 0)
+        assert (s, ok) == (3, True)
+        assert m.step(3, reg.READ, 3, 0) == (3, True)
+        assert m.step(3, reg.READ, 4, 0)[1] is False
+        assert m.step(3, reg.CAS, 3, 5) == (5, True)
+        s, ok = m.step(3, reg.CAS, 2, 5)
+        assert (s, ok) == (3, False)
+
+    def test_jax_matches_python(self):
+        m = CasRegister()
+        rng = np.random.default_rng(0)
+        states = rng.integers(-3, 6, 200).astype(np.int32)
+        fs = rng.integers(0, 3, 200).astype(np.int32)
+        a = rng.integers(-3, 6, 200).astype(np.int32)
+        b = rng.integers(-3, 6, 200).astype(np.int32)
+        js, jl = m.jax_step(jnp.array(states), jnp.array(fs), jnp.array(a), jnp.array(b))
+        for i in range(200):
+            ps, pl = m.step(int(states[i]), int(fs[i]), int(a[i]), int(b[i]))
+            assert int(js[i]) == ps, i
+            assert bool(jl[i]) == pl, i
+
+    def test_encode_semantics(self):
+        m = CasRegister()
+        # fail ops dropped (never happened)
+        assert m.encode_pair(pair("cas", (1, 2), FAIL)) is None
+        # info reads dropped (constrain nothing)
+        assert m.encode_pair(pair("read", None, INFO)) is None
+        assert m.encode_pair(pair("read", None, None)) is None
+        # ok read forced with observed value
+        e = m.encode_pair(pair("read", None, OK, 4))
+        assert (e.f, e.a, e.forced) == (reg.READ, 4, True)
+        # info write optional
+        e = m.encode_pair(pair("write", 2, INFO))
+        assert (e.f, e.a, e.forced) == (reg.WRITE, 2, False)
+        e = m.encode_pair(pair("cas", (1, 2), OK, True))
+        assert (e.f, e.a, e.b, e.forced) == (reg.CAS, 1, 2, True)
+
+
+class TestCounter:
+    def test_step_semantics(self):
+        m = Counter()
+        assert m.step(0, cnt.ADD, 5, 0) == (5, True)
+        assert m.step(5, cnt.ADD, -2, 0) == (3, True)
+        assert m.step(3, cnt.READ, 3, 0) == (3, True)
+        assert m.step(3, cnt.READ, 4, 0)[1] is False
+        assert m.step(3, cnt.ADD_AND_GET, 2, 5) == (5, True)
+        assert m.step(3, cnt.ADD_AND_GET, 2, 6)[1] is False
+
+    def test_int32_wraparound_matches(self):
+        m = Counter()
+        s, _ = m.step(2**31 - 1, cnt.ADD, 1, 0)
+        js, _ = m.jax_step(jnp.int32(2**31 - 1), jnp.int32(cnt.ADD),
+                           jnp.int32(1), jnp.int32(0))
+        assert s == int(js) == -(2**31)
+
+    def test_jax_matches_python(self):
+        m = Counter()
+        rng = np.random.default_rng(1)
+        states = rng.integers(-10, 10, 200).astype(np.int32)
+        fs = rng.integers(0, 3, 200).astype(np.int32)
+        a = rng.integers(-5, 6, 200).astype(np.int32)
+        b = rng.integers(-10, 10, 200).astype(np.int32)
+        js, jl = m.jax_step(jnp.array(states), jnp.array(fs), jnp.array(a), jnp.array(b))
+        for i in range(200):
+            ps, pl = m.step(int(states[i]), int(fs[i]), int(a[i]), int(b[i]))
+            assert int(js[i]) == ps, i
+            assert bool(jl[i]) == pl, i
+
+    def test_encode_semantics(self):
+        m = Counter()
+        # decr maps to negated add (counter.clj:56-59)
+        e = m.encode_pair(pair("decr", 3, OK))
+        assert (e.f, e.a, e.forced) == (cnt.ADD, -3, True)
+        # completed add-and-get carries [delta, new]
+        e = m.encode_pair(pair("add-and-get", 2, OK, (2, 7)))
+        assert (e.f, e.a, e.b, e.forced) == (cnt.ADD_AND_GET, 2, 7, True)
+        # info add-and-get degrades to optional add (unknown return)
+        e = m.encode_pair(pair("add-and-get", 2, INFO))
+        assert (e.f, e.a, e.forced) == (cnt.ADD, 2, False)
+        # info read dropped
+        assert m.encode_pair(pair("read", None, INFO)) is None
