@@ -10,3 +10,11 @@ linear/WGL search is re-implemented here with CPU and TPU backends.
 from .base import Checker, compose, VALID, INVALID, UNKNOWN  # noqa: F401
 from .wgl_cpu import check_encoded_cpu, CpuCheckResult  # noqa: F401
 from .linearizable import LinearizableChecker, check_histories  # noqa: F401
+from .independent import (  # noqa: F401
+    IndependentChecker,
+    IndependentLinearizable,
+    split_by_key,
+)
+from .stats import StatsChecker, UnhandledExceptionsChecker  # noqa: F401
+from .perf import PerfChecker  # noqa: F401
+from .timeline import TimelineChecker  # noqa: F401

@@ -1,0 +1,91 @@
+"""Multi-key history decomposition.
+
+Equivalent of jepsen.independent/checker (reference register.clj:106):
+ops whose values are ``(key, value)`` tuples are split into per-key
+sub-histories, each checked independently.
+
+TPU-first twist: for linearizability this is not a loop over keys — the
+per-key sub-histories are exactly the batch dimension the frontier kernel
+vmaps over (SURVEY.md §2.4 row 2), so `IndependentLinearizable` packs all
+keys into ONE batched kernel launch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..history.ops import History, Op
+from ..models.base import Model
+from .base import Checker, merge_valid
+from .linearizable import check_histories
+
+
+def split_by_key(history: History) -> Dict:
+    """Split a history of (key, value)-tupled ops into per-key histories,
+    unwrapping values. Ops without tuple values raise — mixing independent
+    and plain ops in one history is a bug."""
+    subs: Dict = {}
+    for op in history:
+        if op.value is None and op.type == "invoke":
+            raise ValueError(
+                f"independent history contains untupled op: {op}"
+            )
+        key, value = op.value if op.value is not None else (None, None)
+        if key is None:
+            continue
+        sub = subs.setdefault(key, History())
+        sub.append(op.replace(value=value, index=op.index))
+    return subs
+
+
+class IndependentChecker(Checker):
+    """Generic per-key composition: run `checker_factory()` per key."""
+
+    def __init__(self, checker_factory: Callable[[], Checker]):
+        self.checker_factory = checker_factory
+
+    def check(self, test, history, opts=None) -> dict:
+        if not isinstance(history, History):
+            history = History(history)
+        subs = split_by_key(history.client_ops())
+        results = {
+            str(k): self.checker_factory().check(test, sub, opts)
+            for k, sub in subs.items()
+        }
+        return {
+            "valid?": merge_valid(r.get("valid?") for r in results.values()),
+            "key-count": len(subs),
+            "results": results,
+        }
+
+
+class IndependentLinearizable(Checker):
+    """Per-key linearizability as one batched TPU kernel launch."""
+
+    def __init__(self, model_factory: Callable[[], Model],
+                 algorithm: str = "auto",
+                 n_configs: Optional[int] = None,
+                 n_slots: Optional[int] = None):
+        self.model_factory = model_factory
+        self.algorithm = algorithm
+        self.n_configs = n_configs
+        self.n_slots = n_slots
+
+    def check(self, test, history, opts=None) -> dict:
+        if not isinstance(history, History):
+            history = History(history)
+        subs = split_by_key(history.client_ops())
+        if not subs:
+            return {"valid?": True, "key-count": 0, "results": {}}
+        keys = list(subs.keys())
+        model = self.model_factory()
+        rs = check_histories(
+            [subs[k] for k in keys], model, self.algorithm,
+            self.n_configs, self.n_slots,
+        )
+        results = {str(k): r for k, r in zip(keys, rs)}
+        return {
+            "valid?": merge_valid(r.get("valid?") for r in results.values()),
+            "key-count": len(keys),
+            "results": results,
+        }

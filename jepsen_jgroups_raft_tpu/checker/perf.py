@@ -1,0 +1,165 @@
+"""Performance checker: latency and throughput over time.
+
+Equivalent of jepsen checker/perf (reference raft.clj:74): computes
+latency quantiles and completion-rate series from the history, annotated
+with nemesis activity windows (the reference shades nemesis intervals into
+its gnuplot output, membership.clj:158-161). Renders SVG plots into the
+store directory when one is available — no gnuplot dependency, just
+generated SVG.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from ..history.ops import INFO, INVOKE, NEMESIS, OK, History
+from .base import Checker
+
+
+def _quantile(sorted_xs: List[float], q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    i = min(len(sorted_xs) - 1, int(q * len(sorted_xs)))
+    return sorted_xs[i]
+
+
+class PerfChecker(Checker):
+    def __init__(self, bucket_s: float = 1.0, render: bool = True):
+        self.bucket_s = bucket_s
+        self.render = render
+
+    def check(self, test, history, opts=None) -> dict:
+        if not isinstance(history, History):
+            history = History(history)
+        pairs = history.client_ops().pairs()
+        lat_by_f: dict = {}
+        points: List[Tuple[float, float, str, str]] = []  # t, latency, f, type
+        rate: dict = {}
+        for p in pairs:
+            if p.completion is None:
+                continue
+            t0, t1 = p.invoke.time, p.completion.time
+            if t0 < 0 or t1 < 0:
+                continue
+            lat = (t1 - t0) / 1e9
+            lat_by_f.setdefault(p.f, []).append(lat)
+            points.append((t0 / 1e9, lat, p.f, p.completion.type))
+            b = int(t1 / 1e9 / self.bucket_s)
+            rate.setdefault(p.completion.type, {})
+            rate[p.completion.type][b] = rate[p.completion.type].get(b, 0) + 1
+
+        nemesis_windows = _nemesis_windows(history)
+        out = {"valid?": True, "latency": {}, "rate": {}}
+        for f, lats in lat_by_f.items():
+            lats.sort()
+            out["latency"][f] = {
+                "count": len(lats),
+                "median": _quantile(lats, 0.5),
+                "p95": _quantile(lats, 0.95),
+                "p99": _quantile(lats, 0.99),
+                "max": lats[-1],
+            }
+        for t, buckets in rate.items():
+            # Mean over the elapsed span, not over occupied buckets — a
+            # bursty history must not overstate its rate.
+            span = (max(buckets) - min(buckets) + 1) * self.bucket_s
+            out["rate"][t] = {"mean-hz": sum(buckets.values()) / span}
+        out["nemesis-windows"] = nemesis_windows
+        store_dir = (test or {}).get("store_dir")
+        if self.render and store_dir:
+            try:
+                path = Path(store_dir) / "latency.svg"
+                path.write_text(_latency_svg(points, nemesis_windows))
+                out["plot"] = str(path)
+            except Exception:  # plotting must never fail a run
+                pass
+        return out
+
+
+#: fault-op f → healing-op f (the start/stop convention nemesis packages
+#: follow; the reference's packages shade exactly these spans into perf
+#: plots, membership.clj:158-161).
+FAULT_HEALS = {
+    "start-partition": "stop-partition",
+    "pause": "resume",
+    "kill": "start",
+    "shrink": "grow",
+}
+
+
+def _nemesis_windows(history: History,
+                     heals: Optional[dict] = None) -> List[dict]:
+    """Fault activity windows: from the *completion* of a fault op to the
+    completion of its healing op. The runner records each nemesis action
+    twice (invocation then completion, both type info), so per f the 2nd,
+    4th, ... occurrences are completions."""
+    heals = FAULT_HEALS if heals is None else heals
+    starters = set(heals)
+    stoppers = {v: k for k, v in heals.items()}
+    seen: dict = {}
+    open_at: dict = {}  # fault f -> start time
+    windows: List[dict] = []
+    for op in history.nemesis_ops():
+        f = op.f
+        seen[f] = seen.get(f, 0) + 1
+        if seen[f] % 2 == 1:
+            continue  # invocation record; windows anchor on completions
+        if f in starters and f not in open_at:
+            open_at[f] = op.time
+        elif f in stoppers:
+            started = open_at.pop(stoppers[f], None)
+            if started is not None:
+                windows.append({"f": stoppers[f], "start": started / 1e9,
+                                "end": op.time / 1e9})
+    for f, t in open_at.items():
+        windows.append({"f": f, "start": t / 1e9, "end": None})
+    return windows
+
+
+_TYPE_COLOR = {OK: "#2a7", INFO: "#fa0", "fail": "#d33"}
+
+
+def _latency_svg(points, windows, w: int = 900, h: int = 360) -> str:
+    """Scatter of op latency over time, log-y, nemesis windows shaded."""
+    if not points:
+        return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+    tmax = max(p[0] for p in points) or 1.0
+    lmin = max(1e-5, min(p[1] for p in points if p[1] > 0) if any(
+        p[1] > 0 for p in points) else 1e-4)
+    lmax = max(p[1] for p in points) or 1.0
+    pad = 45
+
+    def x(t):
+        return pad + (w - 2 * pad) * t / tmax
+
+    def y(lat):
+        lat = max(lat, lmin)
+        return h - pad - (h - 2 * pad) * (
+            (math.log10(lat) - math.log10(lmin))
+            / max(1e-9, math.log10(lmax) - math.log10(lmin)))
+
+    parts = [
+        f"<svg xmlns='http://www.w3.org/2000/svg' width='{w}' height='{h}' "
+        f"font-family='sans-serif' font-size='11'>",
+        f"<rect width='{w}' height='{h}' fill='white'/>",
+    ]
+    for win in windows:
+        end = win["end"] if win["end"] is not None else tmax
+        parts.append(
+            f"<rect x='{x(win['start']):.1f}' y='{pad}' "
+            f"width='{max(1.0, x(end) - x(win['start'])):.1f}' "
+            f"height='{h - 2 * pad}' fill='#f6c' opacity='0.15'/>")
+    for t, lat, f, typ in points:
+        parts.append(
+            f"<circle cx='{x(t):.1f}' cy='{y(lat):.1f}' r='1.6' "
+            f"fill='{_TYPE_COLOR.get(typ, '#888')}' opacity='0.7'/>")
+    parts.append(
+        f"<line x1='{pad}' y1='{h - pad}' x2='{w - pad}' y2='{h - pad}' "
+        f"stroke='#333'/>"
+        f"<line x1='{pad}' y1='{pad}' x2='{pad}' y2='{h - pad}' stroke='#333'/>"
+        f"<text x='{w // 2}' y='{h - 8}'>time (s)</text>"
+        f"<text x='4' y='{h // 2}' transform='rotate(-90 10 {h // 2})'>"
+        f"latency (s, log)</text></svg>")
+    return "".join(parts)

@@ -1,0 +1,79 @@
+"""Per-process operation timeline, rendered to HTML.
+
+Equivalent of jepsen.checker.timeline/html (reference register.clj:108,
+counter.clj:134, leader.clj:82): one swimlane per process, one box per op
+spanning invocation→completion, colored by completion type. Written into
+the store directory when available.
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+from pathlib import Path
+
+from ..history.ops import FAIL, INFO, OK, History
+from .base import Checker
+
+_COLORS = {OK: "#9ce29c", FAIL: "#f5a3a3", INFO: "#ffd27f"}
+
+
+class TimelineChecker(Checker):
+    def __init__(self, filename: str = "timeline.html"):
+        self.filename = filename
+
+    def check(self, test, history, opts=None) -> dict:
+        if not isinstance(history, History):
+            history = History(history)
+        doc = render_timeline(history)
+        out = {"valid?": True}
+        store_dir = (test or {}).get("store_dir")
+        if store_dir:
+            path = Path(store_dir) / self.filename
+            try:
+                path.write_text(doc)
+                out["file"] = str(path)
+            except OSError:
+                pass
+        else:
+            out["html"] = doc
+        return out
+
+
+def render_timeline(history: History, px_per_s: float = 100.0) -> str:
+    pairs = history.client_ops().pairs()
+    if not pairs:
+        return "<html><body>empty history</body></html>"
+    tmax = max((p.completion.time for p in pairs if p.completion is not None),
+               default=0)
+    procs = sorted({p.invoke.process for p in pairs},
+                   key=lambda x: (str(type(x)), x))
+    lane = {p: i for i, p in enumerate(procs)}
+    rows = []
+    for p in pairs:
+        t0 = p.invoke.time / 1e9
+        t1 = (p.completion.time if p.completion is not None else tmax) / 1e9
+        typ = p.ctype
+        left = 80 + t0 * px_per_s
+        width = max(2.0, (t1 - t0) * px_per_s)
+        top = 10 + lane[p.invoke.process] * 26
+        label = html_mod.escape(
+            f"{p.f} {p.invoke.value!r} -> {typ}"
+            + (f" {p.completion.value!r}" if p.completion is not None else ""))
+        rows.append(
+            f"<div class='op' title='{label}' style='left:{left:.0f}px;"
+            f"top:{top}px;width:{width:.0f}px;"
+            f"background:{_COLORS.get(typ, '#ddd')}'>{html_mod.escape(str(p.f))}"
+            f"</div>")
+    lanes = "".join(
+        f"<div class='lane' style='top:{10 + i * 26}px'>{html_mod.escape(str(pr))}</div>"
+        for pr, i in lane.items())
+    height = 40 + len(procs) * 26
+    return (
+        "<html><head><style>"
+        ".op{position:absolute;height:20px;font-size:10px;overflow:hidden;"
+        "border:1px solid #555;border-radius:3px;padding:0 2px;}"
+        ".lane{position:absolute;left:0;width:75px;font:11px sans-serif;"
+        "text-align:right;}"
+        "body{position:relative;font-family:sans-serif;}"
+        f"</style></head><body style='height:{height}px'>"
+        f"{lanes}{''.join(rows)}</body></html>")

@@ -1,0 +1,9 @@
+"""Test orchestration.
+
+Equivalent surface: jepsen.core/run! and the interpreter that drives
+worker threads + the nemesis thread from a generator, records the history,
+runs the composed checker, and persists results (SURVEY.md §3.1).
+"""
+
+from .runner import run_test, Scheduler  # noqa: F401
+from .store import save_test, store_root  # noqa: F401

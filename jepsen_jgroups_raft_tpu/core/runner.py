@@ -1,0 +1,264 @@
+"""The test interpreter.
+
+Equivalent of jepsen.core/run! (reference L0; composed at raft.clj:54-92):
+
+  1. set up the DB (SUT node lifecycle) on every node concurrently,
+  2. spawn `concurrency` client worker threads (each bound round-robin to a
+     node, each with its own client connection) plus one nemesis thread,
+  3. drive them from the generator under a scheduler lock, recording every
+     invocation/completion into the history with ns timestamps and dense
+     indices,
+  4. process-id bookkeeping: a worker whose op ends `info` (indefinite —
+     the op may still execute server-side) retires its process id and
+     continues as `process + concurrency` with a fresh client connection,
+     exactly jepsen's crashed-process rule — this is what makes the
+     history's forever-concurrent semantics true,
+  5. tear down, run the composed checker over the history, persist to
+     store/.
+
+Wall-clock concurrency is host-side Python threading (the reference's
+worker threads, SURVEY.md §2.4 row 1): these threads spend their lives
+blocked on sockets, so the GIL is irrelevant; the compute-heavy part (the
+checker) runs on TPU afterwards.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..client.errors import with_errors
+from ..generator.base import NEMESIS_THREAD, PENDING, Generator, to_gen
+from ..history.ops import INFO, INVOKE, NEMESIS, History, Op
+from .store import prepare_dir, save_test
+
+LOG = logging.getLogger("jgraft.core")
+
+#: seconds between generator polls when PENDING.
+POLL_INTERVAL = 0.002
+
+
+class Scheduler:
+    """Serializes generator access across workers; owns test time."""
+
+    def __init__(self, gen, test: dict):
+        self.gen: Optional[Generator] = to_gen(gen)
+        self.test = test
+        self.lock = threading.Lock()
+        self.t0 = time.monotonic_ns()
+        self.busy = 0
+
+    def now(self) -> int:
+        return time.monotonic_ns() - self.t0
+
+    def next_op(self, thread) -> Optional[dict]:
+        """Block until an op is available for `thread`, or None when the
+        generator is exhausted."""
+        while True:
+            with self.lock:
+                if self.gen is None:
+                    return None
+                ctx = {"time": self.now(), "thread": thread, "busy": self.busy}
+                r = self.gen.op(self.test, ctx)
+                if r is None:
+                    self.gen = None
+                    return None
+                op, g2 = r
+                self.gen = g2
+                if op != PENDING:
+                    self.busy += 1
+                    return op
+            time.sleep(POLL_INTERVAL)
+
+    def complete(self, event: Op) -> None:
+        with self.lock:
+            self.busy -= 1
+            if self.gen is not None:
+                ctx = {"time": self.now(), "thread": None, "busy": self.busy}
+                self.gen = self.gen.update(self.test, ctx, event)
+
+
+def run_test(test: dict) -> dict:
+    """Run a test map; returns it with :history and :results filled in.
+
+    Recognized keys (jepsen test-map equivalents, raft.clj:54-92):
+      name, nodes, concurrency, client (Client), nemesis (Nemesis),
+      generator, checker (Checker), db (DB), members (mutable set — the
+      shared membership atom raft.clj:70), idempotent (op f's safe to fail
+      on indefinite errors), store (bool).
+    """
+
+    test = dict(test)
+    test.setdefault("name", "test")
+    test.setdefault("nodes", [f"n{i}" for i in range(1, 6)])
+    test.setdefault("concurrency", 5)
+    test.setdefault("idempotent", set())
+    if "members" not in test or test["members"] is None:
+        test["members"] = set(test["nodes"])
+    test.setdefault("start_time", time.time())
+
+    history = History()
+    hlock = threading.Lock()
+
+    def record(op: Op) -> Op:
+        with hlock:
+            op.time = sched.now()
+            history.append(op)  # assigns index
+            return op
+
+    db = test.get("db")
+    if db is not None:
+        LOG.info("setting up DB on %s", test["nodes"])
+        with ThreadPoolExecutor(len(test["nodes"])) as ex:
+            list(ex.map(lambda n: db.setup(test, n), test["nodes"]))
+
+    sched = Scheduler(test.get("generator"), test)
+    concurrency = int(test["concurrency"])
+
+    def client_worker(i: int) -> None:
+        process = i
+        node = test["nodes"][i % len(test["nodes"])]
+        proto = test.get("client")
+        client = proto.open(test, node) if proto is not None else None
+        if client is not None:
+            client.setup(test)
+        try:
+            while True:
+                opd = sched.next_op(i)
+                if opd is None:
+                    return
+                inv = Op(process=process, type=INVOKE, f=opd["f"],
+                         value=opd.get("value"))
+                record(inv)
+                if proto is not None and client is None:
+                    # Previous reconnect failed; retry before invoking.
+                    try:
+                        client = proto.open(test, node)
+                        client.setup(test)
+                    except Exception:
+                        LOG.exception("worker %d: reconnect failed", i)
+                if proto is None:
+                    comp = inv.replace(type="ok")
+                elif client is None:
+                    comp = inv.replace(type="fail",
+                                       error="connect: reconnect failed")
+                else:
+                    try:
+                        comp = with_errors(
+                            lambda t, o: client.invoke(t, o), test,
+                            inv.replace(), test["idempotent"])
+                    except Exception as e:
+                        # Non-client exception (a bug in the client or
+                        # workload): never kill the worker silently —
+                        # record it as an indefinite crash, like jepsen.
+                        LOG.exception("worker %d: invoke raised", i)
+                        comp = inv.replace(type=INFO, error=repr(e))
+                comp.process = process
+                comp = comp.replace(index=-1)
+                record(comp)
+                sched.complete(comp)
+                if comp.type == INFO:
+                    # Crashed process: a fresh identity + connection
+                    # (jepsen's thread->process remapping).
+                    process += concurrency
+                    if client is not None:
+                        try:
+                            client.close(test)
+                        except Exception:
+                            pass
+                        try:
+                            client = proto.open(test, node)
+                            client.setup(test)
+                        except Exception:
+                            LOG.exception(
+                                "worker %d: reopen failed; will retry", i)
+                            client = None
+        finally:
+            if client is not None:
+                try:
+                    client.teardown(test)
+                    client.close(test)
+                except Exception:
+                    LOG.exception("client teardown failed (node %s)", node)
+
+    def nemesis_worker() -> None:
+        # Always run the nemesis loop: with no nemesis configured, a noop
+        # one drains any nemesis-routed ops (otherwise the generator would
+        # never exhaust and client workers would spin forever).
+        from ..nemesis.base import NoopNemesis
+
+        nem = test.get("nemesis") or NoopNemesis()
+        try:
+            nem = nem.setup(test) or nem
+        except Exception:
+            # A failed nemesis setup must not strand the run: keep draining
+            # nemesis-routed ops with a noop (annotated) nemesis.
+            LOG.exception("nemesis setup failed; continuing with noop")
+            nem = NoopNemesis()
+        try:
+            while True:
+                opd = sched.next_op(NEMESIS_THREAD)
+                if opd is None:
+                    return
+                inv = Op(process=NEMESIS, type=INFO, f=opd["f"],
+                         value=opd.get("value"))
+                record(inv)
+                try:
+                    comp = nem.invoke(test, inv.replace())
+                except Exception as e:
+                    LOG.exception("nemesis op %s failed", opd["f"])
+                    comp = inv.replace(error=repr(e))
+                comp.process = NEMESIS
+                comp.type = INFO
+                comp = comp.replace(index=-1)
+                record(comp)
+                sched.complete(comp)
+        finally:
+            try:
+                nem.teardown(test)
+            except Exception:
+                LOG.exception("nemesis teardown failed")
+
+    threads = [
+        threading.Thread(target=client_worker, args=(i,), daemon=True,
+                         name=f"worker-{i}")
+        for i in range(concurrency)
+    ]
+    threads.append(
+        threading.Thread(target=nemesis_worker, daemon=True, name="nemesis"))
+    LOG.info("running %s: %d workers + nemesis over %s",
+             test["name"], concurrency, test["nodes"])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    if db is not None:
+        logs = {}
+        if hasattr(db, "log_files"):
+            for n in test["nodes"]:
+                try:
+                    logs[n] = db.log_files(test, n)
+                except Exception:
+                    pass
+        test["log_files"] = logs
+        with ThreadPoolExecutor(len(test["nodes"])) as ex:
+            list(ex.map(lambda n: db.teardown(test, n), test["nodes"]))
+
+    test["history"] = history
+    if test.get("store", True) and "store_dir" not in test:
+        test["store_dir"] = prepare_dir(test)
+    checker = test.get("checker")
+    if checker is not None:
+        LOG.info("checking %d-op history", len(history))
+        test["results"] = checker.check(test, history, {})
+    else:
+        test["results"] = {"valid?": True, "note": "no checker"}
+
+    if test.get("store", True):
+        save_test(test, history, test["results"])
+    LOG.info("run complete: valid? = %s", test["results"].get("valid?"))
+    return test

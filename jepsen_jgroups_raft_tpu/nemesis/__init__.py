@@ -1,0 +1,9 @@
+"""Fault injection.
+
+Equivalent surface: jepsen.nemesis + jepsen.nemesis.combined as the
+reference uses them (nemesis/nemesis.clj, nemesis/membership.clj):
+partition / kill / pause / membership fault packages with targeted victim
+classes, schedules, and final-generator healing.
+"""
+
+from .base import Nemesis, NoopNemesis, ComposedNemesis, compose_nemeses  # noqa: F401

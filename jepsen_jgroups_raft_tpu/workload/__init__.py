@@ -1,0 +1,30 @@
+"""Workloads.
+
+Equivalent of the reference's workload registry (workload/workload.clj:7-15):
+name → constructor; each constructor takes the options map and returns a
+dict with client / checker / generator / idempotent keys (the
+`{:client :checker :generator}` shape of register.clj:100-117).
+"""
+
+from .register import register_workload
+from .counter import counter_workload
+from .leader import leader_workload
+
+
+def single_register(opts):
+    return register_workload({**opts, "keys": range(1)})
+
+
+def multi_register(opts):
+    import itertools
+
+    return register_workload({**opts, "keys": itertools.count()})
+
+
+#: name → constructor (reference workload.clj:10-15).
+WORKLOADS = {
+    "single-register": single_register,
+    "multi-register": multi_register,
+    "counter": counter_workload,
+    "election": leader_workload,
+}

@@ -1,0 +1,69 @@
+"""Leader-election workload: inspect leadership, check election safety.
+
+Equivalent of the reference's election workload (workload/leader.clj):
+a single `inspect` op (leader.clj:14-17) observing (leader, term) tuples,
+checked for election safety — no two leaders in one term (leader.clj:63-75;
+like the reference, majority agreement is NOT checked).
+"""
+
+from __future__ import annotations
+
+from ..checker.base import Checker, compose
+from ..checker.stats import StatsChecker
+from ..checker.timeline import TimelineChecker
+from ..client.base import Client
+from ..generator.base import Limit, Mix
+from ..history.ops import History, OK, Op
+from ..models.leader import LeaderModel
+
+
+def inspect(test, ctx):
+    return {"f": "inspect", "value": None}
+
+
+class LeaderInspectionClient(Client):
+    def __init__(self, conn_factory, timeout: float = 10.0):
+        self.conn_factory = conn_factory
+        self.timeout = timeout
+        self.conn = None
+
+    def open(self, test, node):
+        c = LeaderInspectionClient(self.conn_factory, self.timeout)
+        c.conn = self.conn_factory(node, "election", self.timeout)
+        return c
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f != "inspect":
+            raise ValueError(f"election: unknown op {op.f!r}")
+        leader, term = self.conn.inspect()
+        return op.replace(type=OK, value=(leader, term))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class ElectionSafetyChecker(Checker):
+    def check(self, test, history, opts=None) -> dict:
+        if not isinstance(history, History):
+            history = History(history)
+        return LeaderModel().check(history.client_ops())
+
+
+def leader_workload(opts: dict) -> dict:
+    total_ops = opts.get("total_ops")
+    gen = Mix([inspect])
+    if total_ops:
+        gen = Limit(total_ops, gen)
+    return {
+        "client": LeaderInspectionClient(
+            opts["conn_factory"], opts.get("operation_timeout", 10.0)),
+        "checker": compose({
+            "timeline": TimelineChecker(),
+            "stats": StatsChecker(),
+            "linear": ElectionSafetyChecker(),
+        }),
+        "generator": gen,
+        "idempotent": {"inspect"},  # leader.clj:39
+        "model": LeaderModel,
+    }

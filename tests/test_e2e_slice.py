@@ -1,0 +1,171 @@
+"""The minimum end-to-end slice (SURVEY.md §7.3): workloads driven by the
+generator algebra through concurrent clients against the in-process SUT,
+with timeout fault injection producing real info ops, history checked via
+the batched kernel path, results persisted to store/.
+
+This exercises every layer boundary:
+generator → client → history → pack → kernel → checker-compose → store.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker.base import compose
+from jepsen_jgroups_raft_tpu.checker.perf import PerfChecker
+from jepsen_jgroups_raft_tpu.checker.stats import (
+    StatsChecker,
+    UnhandledExceptionsChecker,
+)
+from jepsen_jgroups_raft_tpu.core.runner import run_test
+from jepsen_jgroups_raft_tpu.core.store import load_history
+from jepsen_jgroups_raft_tpu.generator.base import (
+    Any,
+    Clients,
+    NemesisGen,
+    Phases,
+    Repeat,
+    Sleep,
+    Stagger,
+    TimeLimit,
+)
+from jepsen_jgroups_raft_tpu.history.ops import INFO, NEMESIS, OK
+from jepsen_jgroups_raft_tpu.nemesis.base import Nemesis
+from jepsen_jgroups_raft_tpu.sut.inmemory import InMemoryCluster, LatencyPlan
+from jepsen_jgroups_raft_tpu.workload import WORKLOADS
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def make_test(tmp_path, workload_name, cluster, time_limit=None, nemesis=None,
+              nemesis_gen=None, **opts):
+    base = {
+        "nodes": NODES,
+        "concurrency": 10,
+        "conn_factory": cluster.conn,
+        "operation_timeout": 0.25,
+        "ops_per_key": opts.pop("ops_per_key", 60),
+        **opts,
+    }
+    wl = WORKLOADS[workload_name](base)
+    gen = Clients(Stagger(0.001, wl["generator"]))
+    if nemesis_gen is not None:
+        gen = Any(gen, NemesisGen(nemesis_gen))
+    if time_limit:
+        gen = TimeLimit(time_limit, gen)
+    return {
+        "name": f"e2e-{workload_name}",
+        "nodes": NODES,
+        "concurrency": base["concurrency"],
+        "client": wl["client"],
+        "generator": gen,
+        "checker": compose({
+            "workload": wl["checker"],
+            "stats": StatsChecker(),
+            "exceptions": UnhandledExceptionsChecker(),
+            "perf": PerfChecker(render=False),
+        }),
+        "nemesis": nemesis,
+        "idempotent": wl["idempotent"],
+        "store_root": str(tmp_path / "store"),
+    }
+
+
+def test_single_register_slice(tmp_path):
+    cluster = InMemoryCluster(NODES, LatencyPlan(seed=1))
+    try:
+        test = run_test(make_test(tmp_path, "single-register", cluster))
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    assert res["valid?"] is True, res
+    lin = res["workload"]["linear"]
+    assert lin["key-count"] == 1
+    # the kernel path actually ran
+    algos = {r["algorithm"] for r in lin["results"].values()}
+    assert algos <= {"jax", "trivial", "cpu"}
+    # history really has concurrent completed ops
+    oks = [op for op in test["history"] if op.type == OK]
+    assert len(oks) > 30
+
+
+def test_register_with_timeout_faults_and_store(tmp_path):
+    # slow_prob forces genuine indefinite ops (client times out at 0.25s,
+    # op applies at +0.5s server-side)
+    cluster = InMemoryCluster(
+        NODES, LatencyPlan(slow_prob=0.08, slow_s=0.5, seed=7))
+    try:
+        test = run_test(make_test(tmp_path, "single-register", cluster,
+                                  ops_per_key=80))
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    # a linearizable SUT must verify even under timeout pollution
+    assert res["valid?"] is True, res
+    infos = [op for op in test["history"]
+             if op.type == INFO and op.process != NEMESIS]
+    assert infos, "expected timeout-induced info ops"
+    assert any("timeout" in (op.error or "") for op in infos)
+    # (deterministic process-retirement coverage lives in test_runner.py)
+    # store round-trip
+    run_dir = test["store_dir"]
+    assert os.path.exists(os.path.join(run_dir, "history.jsonl"))
+    assert os.path.exists(os.path.join(run_dir, "results.json"))
+    h2 = load_history(run_dir)
+    assert len(h2) == len(test["history"])
+    with open(os.path.join(run_dir, "results.json")) as f:
+        assert json.load(f)["valid?"] is True
+
+
+def test_multi_register_uses_batch(tmp_path):
+    cluster = InMemoryCluster(NODES, LatencyPlan(seed=3))
+    try:
+        test = run_test(make_test(tmp_path, "multi-register", cluster,
+                                  ops_per_key=30, time_limit=4))
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    assert res["valid?"] is True, res
+    assert res["workload"]["linear"]["key-count"] >= 2
+
+
+def test_counter_slice(tmp_path):
+    cluster = InMemoryCluster(NODES, LatencyPlan(seed=5))
+    try:
+        test = run_test(make_test(tmp_path, "counter", cluster,
+                                  total_ops=150))
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    assert res["valid?"] is True, res
+    assert res["stats"]["valid?"] is True
+
+
+def test_election_slice_with_elections(tmp_path):
+    cluster = InMemoryCluster(NODES, LatencyPlan(seed=9))
+
+    class ElectNemesis(Nemesis):
+        fs = ("elect",)
+
+        def invoke(self, test, op):
+            cluster.elect()
+            return op.replace(value="re-elected")
+
+    nemesis_gen = Repeat({"f": "elect"}, n=5)
+    from jepsen_jgroups_raft_tpu.generator.base import Delay
+
+    try:
+        test = run_test(make_test(
+            tmp_path, "election", cluster, total_ops=120,
+            nemesis=ElectNemesis(),
+            nemesis_gen=Delay(0.05, nemesis_gen)))
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    assert res["valid?"] is True, res
+    nem_ops = [op for op in test["history"] if op.process == NEMESIS]
+    assert len(nem_ops) == 10  # 5 invokes + 5 completions
+    obs = res["workload"]["linear"]["observation-count"]
+    assert obs > 50

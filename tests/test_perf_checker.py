@@ -1,0 +1,59 @@
+"""Perf checker unit tests: fault windows and rate computation."""
+
+from jepsen_jgroups_raft_tpu.checker.perf import PerfChecker
+from jepsen_jgroups_raft_tpu.history.ops import (
+    INFO,
+    INVOKE,
+    NEMESIS,
+    OK,
+    History,
+    Op,
+)
+
+
+def _h(rows):
+    h = History()
+    for process, typ, f, value, t in rows:
+        h.append(Op(process, typ, f, value, time=int(t * 1e9)))
+    return h
+
+
+def test_nemesis_windows_span_fault_to_heal():
+    h = _h([
+        (NEMESIS, INFO, "start-partition", None, 10.0),   # invocation
+        (NEMESIS, INFO, "start-partition", None, 10.01),  # completion
+        (0, INVOKE, "read", None, 12.0),
+        (0, OK, "read", 1, 12.1),
+        (NEMESIS, INFO, "stop-partition", None, 40.0),
+        (NEMESIS, INFO, "stop-partition", None, 40.02),
+    ])
+    r = PerfChecker(render=False).check({}, h)
+    [win] = r["nemesis-windows"]
+    assert win["f"] == "start-partition"
+    assert abs(win["start"] - 10.01) < 1e-6
+    assert abs(win["end"] - 40.02) < 1e-6
+
+
+def test_nemesis_window_unhealed_stays_open():
+    h = _h([
+        (NEMESIS, INFO, "pause", None, 5.0),
+        (NEMESIS, INFO, "pause", None, 5.01),
+    ])
+    r = PerfChecker(render=False).check({}, h)
+    [win] = r["nemesis-windows"]
+    assert win["end"] is None
+
+
+def test_mean_hz_uses_elapsed_span():
+    # 10 ops in one burst at t=50..51 of a longer history: the span runs
+    # from the first to last completion bucket, not occupied buckets only.
+    rows = []
+    for i in range(10):
+        rows.append((i, INVOKE, "read", None, 50.0 + i * 0.05))
+        rows.append((i, OK, "read", 1, 50.01 + i * 0.05))
+    rows.append((90, INVOKE, "read", None, 0.0))
+    rows.append((90, OK, "read", 1, 0.02))
+    r = PerfChecker(render=False).check({}, _h(rows))
+    # 11 oks spanning buckets 0..50 -> ~0.216 Hz; occupied-bucket math
+    # would report ~5.5
+    assert r["rate"]["ok"]["mean-hz"] < 1.0

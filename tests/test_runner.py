@@ -1,0 +1,112 @@
+"""Interpreter semantics tests: process retirement on info, generator
+routing, history recording invariants."""
+
+import threading
+
+from jepsen_jgroups_raft_tpu.client.base import Client
+from jepsen_jgroups_raft_tpu.client.errors import ClientTimeout
+from jepsen_jgroups_raft_tpu.core.runner import run_test
+from jepsen_jgroups_raft_tpu.generator.base import Clients, Limit, Repeat
+from jepsen_jgroups_raft_tpu.history.ops import INFO, INVOKE, OK
+
+
+class FlakyClient(Client):
+    """Times out on the 3rd invoke overall, succeeds otherwise."""
+
+    def __init__(self):
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def open(self, test, node):
+        return self  # shared on purpose: we count globally
+
+    def invoke(self, test, op):
+        with self.lock:
+            self.count += 1
+            c = self.count
+        if c == 3:
+            raise ClientTimeout("injected")
+        return op.replace(type=OK)
+
+
+def test_process_retires_after_info(tmp_path):
+    test = run_test({
+        "name": "retire",
+        "nodes": ["n1"],
+        "concurrency": 1,  # one worker: deterministic process sequencing
+        "client": FlakyClient(),
+        "generator": Clients(Limit(6, Repeat({"f": "write", "value": 1}))),
+        "idempotent": set(),
+        "store_root": str(tmp_path / "store"),
+    })
+    h = test["history"]
+    # op 3 crashed: its completion is info, and the worker continued under
+    # process 0 + concurrency = 1
+    infos = [op for op in h if op.type == INFO]
+    assert len(infos) == 1
+    procs = [op.process for op in h if op.type == INVOKE]
+    assert procs == [0, 0, 0, 1, 1, 1]
+    # indices are dense and ordered
+    assert [op.index for op in h] == list(range(len(h)))
+    # every invoke has exactly one completion and no process invokes twice
+    # while pending
+    pending = set()
+    for op in h:
+        if op.type == INVOKE:
+            assert op.process not in pending
+            pending.add(op.process)
+        else:
+            assert op.process in pending
+            pending.remove(op.process)
+    assert not pending
+
+
+def test_generator_time_monotonic(tmp_path):
+    test = run_test({
+        "name": "mono",
+        "nodes": ["n1"],
+        "concurrency": 3,
+        "client": FlakyClient(),
+        "generator": Clients(Limit(20, Repeat({"f": "write", "value": 1}))),
+        "store": False,
+    })
+    times = [op.time for op in test["history"]]
+    assert times == sorted(times)
+    assert all(t >= 0 for t in times)
+
+
+class BuggyClient(Client):
+    """Raises a non-client exception on the 2nd invoke."""
+
+    def __init__(self):
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self.lock:
+            self.count += 1
+            c = self.count
+        if c == 2:
+            raise ValueError("workload bug")
+        return op.replace(type=OK)
+
+
+def test_worker_survives_non_client_exception(tmp_path):
+    # A buggy client/workload must not silently kill the worker or hang the
+    # run: the op is recorded as an info crash and the run completes.
+    test = run_test({
+        "name": "buggy",
+        "nodes": ["n1"],
+        "concurrency": 1,
+        "client": BuggyClient(),
+        "generator": Clients(Limit(5, Repeat({"f": "write", "value": 1}))),
+        "store": False,
+    })
+    h = test["history"]
+    infos = [op for op in h if op.type == INFO]
+    assert len(infos) == 1
+    assert "ValueError" in infos[0].error
+    assert len([op for op in h if op.type == OK]) == 4
