@@ -1,0 +1,96 @@
+"""North-star benchmark: histories/sec verified on TPU.
+
+Config (BASELINE.md / BASELINE.json): 1000 independent 1k-op CAS-register
+histories from a 5-process workload, verified by the on-device frontier
+kernel. Baseline target: 1000 such histories in <60 s (≈16.7 histories/s);
+`vs_baseline` is the measured rate over that target rate, so ≥1.0 beats the
+north star.
+
+Prints ONE JSON line:
+  {"metric": "histories_per_sec", "value": N, "unit": "hist/s",
+   "vs_baseline": N, ...}
+
+Timing covers pack + device transfer + kernel (one warm-up launch first to
+exclude XLA compilation, which is cached across runs of the same shapes).
+History synthesis is excluded: it stands in for the test run that normally
+produces the history.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+
+def main() -> None:
+    import numpy as np  # noqa: F401
+
+    import jax
+
+    from jepsen_jgroups_raft_tpu.history.packing import encode_history, pack_batch
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.models.register import CasRegister
+    from jepsen_jgroups_raft_tpu.parallel.distributed import maybe_init_distributed
+    from jepsen_jgroups_raft_tpu.parallel.mesh import check_batch_sharded, make_mesh
+
+    maybe_init_distributed()
+
+    n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    n_procs = 5
+
+    rng = random.Random(20260729)
+    model = CasRegister()
+    histories = [
+        random_valid_history(rng, "register", n_ops=n_ops, n_procs=n_procs,
+                             crash_p=0.05)
+        for _ in range(n_histories)
+    ]
+
+    encs = [encode_history(h, model) for h in histories]
+    n_slots = max(8, max(e.n_slots for e in encs))
+    mesh = make_mesh()
+
+    def run():
+        t0 = time.perf_counter()
+        batch = pack_batch(encs)
+        ok, overflow, n_valid, n_unknown = check_batch_sharded(
+            model, batch["events"], mesh, n_configs=128, n_slots=n_slots
+        )
+        dt = time.perf_counter() - t0
+        return dt, n_valid, n_unknown
+
+    run()  # warm-up: compile
+    dt, n_valid, n_unknown = run()
+
+    if n_valid + n_unknown != n_histories or n_unknown > 0:
+        # Soundness check: every synthetic history is valid by construction.
+        print(json.dumps({
+            "metric": "histories_per_sec", "value": 0.0, "unit": "hist/s",
+            "vs_baseline": 0.0,
+            "error": f"verdict mismatch: valid={n_valid} "
+                     f"unknown={n_unknown} of {n_histories}",
+        }))
+        return
+
+    rate = n_histories / dt
+    baseline_rate = 1000.0 / 60.0  # north-star target (BASELINE.md)
+    print(json.dumps({
+        "metric": "histories_per_sec",
+        "value": round(rate, 2),
+        "unit": "hist/s",
+        "vs_baseline": round(rate / baseline_rate, 3),
+        "n_histories": n_histories,
+        "n_ops": n_ops,
+        "n_procs": n_procs,
+        "concurrency_window": n_slots,
+        "time_s": round(dt, 3),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

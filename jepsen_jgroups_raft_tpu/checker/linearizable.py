@@ -38,6 +38,15 @@ from .base import Checker, INVALID, UNKNOWN, VALID
 from .wgl_cpu import FrontierOverflow, check_encoded_cpu
 
 
+#: Default CPU-frontier cap. The search is worst-case exponential in the
+#: concurrency window (SURVEY.md §7.4.1); an unbounded fallback would hang
+#: rather than answer on adversarial histories (e.g. 40 mutually-concurrent
+#: writes). Capped, it reports "unknown" instead — the same stance the
+#: reference community takes when knossos becomes "unfeasible to verify"
+#: (reference doc/intro.md:35-41), but as a clean verdict, not an OOM.
+DEFAULT_MAX_CPU_CONFIGS = 1 << 18
+
+
 def check_histories(
     histories: Sequence[History],
     model,
@@ -45,6 +54,7 @@ def check_histories(
     n_configs: Optional[int] = None,
     n_slots: Optional[int] = None,
     witness: bool = False,
+    max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
 ) -> list[dict]:
     """Check a batch of histories; returns one result dict per history.
 
@@ -108,7 +118,7 @@ def check_histories(
 
     for i, r in enumerate(results):
         if r is None:
-            results[i] = _check_cpu(encs[i], model, witness)
+            results[i] = _check_cpu(encs[i], model, witness, max_cpu_configs)
     return results  # type: ignore[return-value]
 
 
@@ -129,9 +139,11 @@ def _jx(valid, enc: EncodedHistory, secs: float) -> dict:
     }
 
 
-def _check_cpu(enc: EncodedHistory, model, witness: bool) -> dict:
+def _check_cpu(enc: EncodedHistory, model, witness: bool,
+               max_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS) -> dict:
     try:
-        r = check_encoded_cpu(enc, model, witness=witness)
+        r = check_encoded_cpu(enc, model, max_configs=max_configs,
+                              witness=witness)
     except FrontierOverflow as e:
         return {"valid?": UNKNOWN, "algorithm": "cpu", "error": str(e)}
     out = {
@@ -154,17 +166,20 @@ class LinearizableChecker(Checker):
 
     def __init__(self, model, algorithm: str = "auto",
                  n_configs: Optional[int] = None,
-                 n_slots: Optional[int] = None):
+                 n_slots: Optional[int] = None,
+                 max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS):
         self.model = model
         self.algorithm = algorithm
         self.n_configs = n_configs
         self.n_slots = n_slots
+        self.max_cpu_configs = max_cpu_configs
 
     def check(self, test, history, opts=None) -> dict:
         if not isinstance(history, History):
             history = History(history)
         hist = history.client_ops()
         [result] = check_histories(
-            [hist], self.model, self.algorithm, self.n_configs, self.n_slots
+            [hist], self.model, self.algorithm, self.n_configs, self.n_slots,
+            max_cpu_configs=self.max_cpu_configs,
         )
         return result

@@ -122,9 +122,17 @@ def run_test(test: dict) -> dict:
         process = i
         node = test["nodes"][i % len(test["nodes"])]
         proto = test.get("client")
-        client = proto.open(test, node) if proto is not None else None
-        if client is not None:
-            client.setup(test)
+        client = None
+        if proto is not None:
+            # A connect failure at startup must not kill the worker: the
+            # loop below retries per-op and records :fail until it heals
+            # (otherwise the generator never drains and run_test hangs).
+            try:
+                client = proto.open(test, node)
+                client.setup(test)
+            except Exception:
+                LOG.exception("worker %d: initial open failed; will retry", i)
+                client = None
         try:
             while True:
                 opd = sched.next_op(i)

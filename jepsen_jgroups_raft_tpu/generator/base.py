@@ -86,8 +86,17 @@ class Seq(Generator):
         return self
 
 
-#: Alias with jepsen's name for sequential phases (gen/phases).
-Phases = Seq
+def Phases(*gens) -> Seq:
+    """Sequential phases with a synchronization barrier between them
+    (jepsen gen/phases): phase N+1 starts only after every phase-N op has
+    *completed*, not merely been handed out — otherwise "final" reads run
+    concurrently with unfinished earlier ops and the phase isolation the
+    reference's schedule relies on (raft.clj:78-91) silently weakens."""
+    items: list = []
+    for g in gens:
+        items.append(g)
+        items.append(Synchronize())
+    return Seq(items[:-1] if items else items)
 
 
 class Mix(Generator):
@@ -108,7 +117,9 @@ class Mix(Generator):
                 choices = choices[:i] + choices[i + 1:]
                 continue
             op, g2 = r
-            nxt = Mix(choices, None)
+            # __new__ clone: Mix() would reseed a fresh Random from OS
+            # entropy on every emission, under the scheduler lock.
+            nxt = Mix.__new__(Mix)
             nxt.rng = self.rng
             nxt.choices = choices[:i] + [g2] + choices[i + 1:]
             return op, nxt
@@ -125,6 +136,16 @@ class Stagger(Generator):
         self.next_at = _next_at  # ns timestamp of next allowed emission
         self.rng = random.Random()
 
+    def _with(self, gen, next_at) -> "Stagger":
+        # __new__ clone: Stagger() reseeds a Random from OS entropy; this
+        # runs once per emitted op under the scheduler lock.
+        nxt = Stagger.__new__(Stagger)
+        nxt.dt = self.dt
+        nxt.gen = gen
+        nxt.next_at = next_at
+        nxt.rng = self.rng
+        return nxt
+
     def op(self, test, ctx):
         now = ctx["time"]
         next_at = self.next_at if self.next_at is not None else now
@@ -134,22 +155,16 @@ class Stagger(Generator):
         if r is None:
             return None
         if r[0] == PENDING:
-            nxt = Stagger(self.dt, r[1], next_at)
-            nxt.rng = self.rng
-            return PENDING, nxt
+            return PENDING, self._with(r[1], next_at)
         op, g2 = r
         gap = int(self.rng.uniform(0, 2 * self.dt) * 1e9)
         # Clamp catch-up: if we fell far behind (idle workers), restart the
         # cadence from now instead of emitting a burst.
         base = next_at if next_at > now - 2 * gap else now
-        nxt = Stagger(self.dt, g2, base + gap)
-        nxt.rng = self.rng
-        return op, nxt
+        return op, self._with(g2, base + gap)
 
     def update(self, test, ctx, event):
-        nxt = Stagger(self.dt, self.gen.update(test, ctx, event), self.next_at)
-        nxt.rng = self.rng
-        return nxt
+        return self._with(self.gen.update(test, ctx, event), self.next_at)
 
 
 class Limit(Generator):
@@ -245,9 +260,11 @@ class Log(Generator):
         self.done = _done
 
     def op(self, test, ctx):
-        if self.done:
-            return None
-        LOG.info(self.message)
+        # Mutating under the scheduler lock; containers that re-poll
+        # exhausted children (Any) must not re-log on every poll.
+        if not self.done:
+            self.done = True
+            LOG.info(self.message)
         return None  # logging is a side effect; nothing to emit
 
 
@@ -334,25 +351,25 @@ class Any(Generator):
         self.gens = [to_gen(g) for g in gens if g is not None]
 
     def op(self, test, ctx):
-        new = list(self.gens)
-        alive = False
-        for i, g in enumerate(new):
+        # Exhausted children are dropped so they aren't re-polled forever.
+        new = []
+        found = None
+        for g in self.gens:
+            if found is not None:
+                new.append(g)
+                continue
             r = g.op(test, ctx)
             if r is None:
                 continue
-            alive = True
             op, g2 = r
-            new[i] = g2
-            if op == PENDING:
-                continue
-            out = Any()
-            out.gens = new
-            return op, out
-        if not alive:
+            new.append(g2)
+            if op != PENDING:
+                found = op
+        if not new:
             return None
         out = Any()
         out.gens = new
-        return PENDING, out
+        return (found if found is not None else PENDING), out
 
     def update(self, test, ctx, event):
         out = Any()

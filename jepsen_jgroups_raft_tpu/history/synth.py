@@ -1,0 +1,152 @@
+"""Synthetic history generation.
+
+A randomized generator with a built-in linearizability guarantee: ops take
+effect atomically at a simulated linearization point between invocation and
+completion, so the produced history IS linearizable by construction.
+Crashed ops may linearize and then never report (→ info), reproducing the
+ambiguous-completion semantics the reference's checker must handle
+(reference workload/client.clj:52-63, doc/intro.md:35-41).
+
+Used three ways (SURVEY.md §4 implications):
+  * differential testing of the CPU and TPU checkers against each other,
+  * adversarial tests via `corrupt` (perturb a completion, oracle decides),
+  * bench.py workload synthesis (north-star configs, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import random
+
+from .ops import FAIL, INFO, INVOKE, OK, History, Op
+
+
+def build_history(rows) -> History:
+    """Build a history from (process, type, f, value) rows; indices/times
+    are assigned from position."""
+    h = History()
+    for i, (process, typ, f, value) in enumerate(rows):
+        h.append(Op(process=process, type=typ, f=f, value=value, time=i))
+    return h
+
+
+def random_valid_history(
+    rng: random.Random,
+    model_kind: str = "register",
+    n_ops: int = 8,
+    n_procs: int = 3,
+    value_range: int = 3,
+    crash_p: float = 0.2,
+) -> History:
+    """Generate a linearizable-by-construction history.
+
+    model_kind: "register" (read/write/cas) or "counter"
+    (read/add/add-and-get). crash_p biases how often a pending op crashes
+    instead of completing (info ops are the checker-pressure knob).
+    """
+
+    state = None if model_kind == "register" else 0
+    rows = []
+    # pending: process -> dict(f, value, linearized?, result)
+    pending: dict = {}
+    done_ops = 0
+    free = list(range(n_procs))
+    while done_ops < n_ops or pending:
+        choices = []
+        if done_ops < n_ops and free:
+            choices.append("invoke")
+        unlin = [p for p, d in pending.items() if not d["lin"]]
+        lin = [p for p, d in pending.items() if d["lin"]]
+        if unlin:
+            choices.append("linearize")
+            if rng.random() < crash_p:
+                choices.append("crash_unapplied")
+        if lin:
+            choices.append("complete")
+            if rng.random() < crash_p:
+                choices.append("crash_applied")
+        if not choices:  # every process crashed before n_ops were issued
+            break
+        act = rng.choice(choices)
+        if act == "invoke":
+            p = free.pop(rng.randrange(len(free)))
+            if model_kind == "register":
+                f = rng.choice(["read", "write", "cas"])
+                if f == "read":
+                    value = None
+                elif f == "write":
+                    value = rng.randrange(value_range)
+                else:
+                    value = (rng.randrange(value_range), rng.randrange(value_range))
+            else:
+                f = rng.choice(["read", "add", "add-and-get"])
+                value = None if f == "read" else rng.randrange(1, value_range + 1)
+            pending[p] = {"f": f, "value": value, "lin": False, "result": None}
+            rows.append((p, INVOKE, f, value))
+            done_ops += 1
+        elif act == "linearize":
+            p = rng.choice(unlin)
+            d = pending[p]
+            f, v = d["f"], d["value"]
+            if model_kind == "register":
+                if f == "read":
+                    d["result"] = state
+                elif f == "write":
+                    state = v
+                    d["result"] = None
+                else:
+                    frm, to = v
+                    if state == frm:
+                        state = to
+                        d["result"] = True
+                    else:
+                        d["result"] = False
+            else:
+                if f == "read":
+                    d["result"] = state
+                elif f == "add":
+                    state += v
+                    d["result"] = None
+                else:
+                    state += v
+                    d["result"] = (v, state)
+            d["lin"] = True
+        elif act == "complete":
+            p = rng.choice(lin)
+            d = pending.pop(p)
+            f, r = d["f"], d["result"]
+            if model_kind == "register" and f == "cas" and r is False:
+                rows.append((p, FAIL, f, d["value"]))
+            elif f == "read":
+                rows.append((p, OK, f, r))
+            elif f == "add-and-get":
+                rows.append((p, OK, f, r))
+            else:
+                rows.append((p, OK, f, d["value"]))
+            free.append(p)
+        else:  # crash (applied or not): completion unknown, process retires
+            p = rng.choice(lin if act == "crash_applied" else unlin)
+            d = pending.pop(p)
+            if rng.random() < 0.5:
+                rows.append((p, INFO, d["f"], d["value"]))
+            # else: no completion row at all — pair_ops treats the dangling
+            # invocation as a crashed (info) op, same as jepsen.
+    return build_history(rows)
+
+
+def corrupt(rng: random.Random, hist: History) -> History:
+    """Randomly perturb one completion value (may or may not break
+    linearizability — the oracle decides)."""
+    rows = [(o.process, o.type, o.f, o.value) for o in hist]
+    idxs = [i for i, r in enumerate(rows) if r[1] == OK]
+    if not idxs:
+        return hist
+    i = rng.choice(idxs)
+    p, t, f, v = rows[i]
+    if f in ("read",):
+        v = (v if isinstance(v, int) and v is not None else 0) + rng.choice([1, -1])
+    elif f == "add-and-get" and v is not None:
+        v = (v[0], v[1] + rng.choice([1, -1]))
+    elif f == "write":
+        pass  # write completions carry the written value; leave
+    rows[i] = (p, t, f, v)
+    return build_history(rows)

@@ -1,0 +1,15 @@
+"""Device-mesh parallelism for the checker backend.
+
+The reference's parallelism inventory (SURVEY.md §2.4) maps the
+key-sharded `independent/checker` decomposition onto the batch dimension:
+every history is an independent linearizability problem, so the natural
+TPU scale-out is a 1-D mesh with the batch sharded across devices and the
+verdict aggregation riding ICI collectives (`psum`), the role NCCL
+all-reduce plays in the reference's world (SURVEY.md §5.8).
+"""
+
+from .mesh import (  # noqa: F401
+    check_batch_sharded,
+    make_mesh,
+    sharded_batch_checker,
+)

@@ -1,0 +1,40 @@
+"""Multi-host initialization for the checker backend.
+
+The reference scales its SUT over multiple hosts with JGroups (SURVEY.md
+§5.8); the checker backend's multi-host analogue is a JAX distributed
+runtime: one process per host, all chips of the slice in one global mesh,
+batch sharded over every device, ICI inside a host/slice and DCN between
+hosts. The harness stays a single control process (like the reference's
+control node) and only the verification fans out.
+
+`maybe_init_distributed` is a no-op unless the standard JAX cluster env
+(``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``) or an
+autodetectable cluster environment is present, so single-host runs (and the
+CPU test mesh) never pay for it.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_init_distributed() -> bool:
+    """Initialize jax.distributed when cluster env vars are set.
+
+    Returns True if the distributed runtime is (now) initialized.
+    Idempotent; safe to call from bench/CLI entry points.
+    """
+    import jax
+
+    if getattr(jax.distributed, "is_initialized", None) and jax.distributed.is_initialized():
+        return True
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    if not coord or not nproc:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
+    return True

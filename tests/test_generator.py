@@ -1,0 +1,94 @@
+"""Generator-combinator unit tests (the §2.3 'generator algebra' surface).
+
+These pin the semantics the reference's schedule relies on: phase
+barriers (gen/phases), log-once (gen/log), and merged client+nemesis
+streams (Any) not re-polling exhausted children.
+"""
+
+import logging
+
+from jepsen_jgroups_raft_tpu.generator import (
+    Any,
+    Log,
+    Mix,
+    Phases,
+    Repeat,
+    Seq,
+    Stagger,
+    Synchronize,
+    PENDING,
+)
+
+
+def drain(gen, ctx=None, max_steps=100):
+    """Pull ops until exhaustion; PENDING counts as a step."""
+    ctx = ctx or {"time": 0, "thread": 0, "busy": 0}
+    out = []
+    for _ in range(max_steps):
+        r = gen.op({}, ctx)
+        if r is None:
+            return out
+        op, gen = r
+        if op != PENDING:
+            out.append(op)
+        ctx = dict(ctx, time=ctx["time"] + 10**9)
+    raise AssertionError("generator did not exhaust")
+
+
+def test_phases_inserts_barrier():
+    g = Phases(Repeat({"f": "a"}, 1), Repeat({"f": "b"}, 1))
+    # With a busy worker, the barrier after phase 1 must hold phase 2.
+    ctx = {"time": 0, "thread": 0, "busy": 0}
+    op, g = g.op({}, ctx)
+    assert op["f"] == "a"
+    busy = dict(ctx, busy=1)
+    r = g.op({}, busy)
+    assert r[0] == PENDING  # barrier: op 'a' still in flight
+    op, g = g.op({}, ctx)  # idle again -> phase 2 opens
+    assert op["f"] == "b"
+    assert g.op({}, ctx) is None
+
+
+def test_phases_empty():
+    assert Phases().op({}, {"time": 0, "thread": 0, "busy": 0}) is None
+
+
+def test_log_logs_once_under_repolling(caplog):
+    g = Any(Log("heal"), Repeat({"f": "x"}, 3))
+    with caplog.at_level(logging.INFO, logger="jgraft.generator"):
+        ops = drain(g)
+    assert len(ops) == 3
+    assert sum("heal" in r.message for r in caplog.records) == 1
+
+
+def test_any_drops_exhausted_children():
+    g = Any(Repeat({"f": "a"}, 1), Repeat({"f": "b"}, 2))
+    ops = drain(g)
+    assert sorted(o["f"] for o in ops) == ["a", "b", "b"]
+
+
+def test_mix_and_stagger_share_rng_across_steps():
+    # __new__-clone path: successive generations keep emitting (op maps are
+    # one-shot, so use op functions for an infinite mix, like counter.clj).
+    g = Stagger(0.0, Mix([lambda t, c: {"f": "a"}, lambda t, c: {"f": "b"}]))
+    ctx = {"time": 0, "thread": 0, "busy": 0}
+    seen = 0
+    for _ in range(10):
+        r = g.op({}, ctx)
+        assert r is not None
+        op, g = r
+        if op != PENDING:
+            seen += 1
+        ctx = dict(ctx, time=ctx["time"] + 10**9)
+    assert seen >= 5
+
+
+def test_mix_of_op_maps_is_one_shot_each():
+    ops = drain(Mix([{"f": "a"}, {"f": "b"}]))
+    assert sorted(o["f"] for o in ops) == ["a", "b"]
+
+
+def test_synchronize_exhausts_when_idle():
+    s = Synchronize()
+    assert s.op({}, {"time": 0, "thread": 0, "busy": 2})[0] == PENDING
+    assert s.op({}, {"time": 0, "thread": 0, "busy": 0}) is None

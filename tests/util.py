@@ -1,144 +1,14 @@
-"""Shared test helpers: a tiny history DSL and a randomized history
-generator with a built-in linearizability guarantee (for differential
-testing of the checkers, SURVEY.md §4 implication (c))."""
+"""Shared test helpers — thin aliases over the package's history
+synthesizer (jepsen_jgroups_raft_tpu/history/synth.py), kept here so tests
+read naturally."""
 
 from __future__ import annotations
 
-import random
-
-from jepsen_jgroups_raft_tpu.history.ops import (
-    FAIL,
-    INFO,
-    INVOKE,
-    OK,
-    History,
-    Op,
+from jepsen_jgroups_raft_tpu.history.synth import (  # noqa: F401
+    build_history,
+    corrupt,
+    random_valid_history,
 )
 
-
-def H(*rows) -> History:
-    """Build a history from (process, type, f, value) rows; indices/times
-    are assigned from position."""
-    h = History()
-    for i, (process, typ, f, value) in enumerate(rows):
-        h.append(Op(process=process, type=typ, f=f, value=value, time=i))
-    return h
-
-
-def random_valid_history(
-    rng: random.Random,
-    model_kind: str = "register",
-    n_ops: int = 8,
-    n_procs: int = 3,
-    value_range: int = 3,
-    crash_p: float = 0.2,
-):
-    """Generate a history that IS linearizable by construction: ops take
-    effect atomically at a simulated linearization point between their
-    invocation and completion; crashed ops may linearize and then never
-    report (-> info)."""
-
-    state = None if model_kind == "register" else 0
-    rows = []
-    # pending: process -> dict(f, value, linearized?, result, will_crash)
-    pending: dict = {}
-    done_ops = 0
-    free = list(range(n_procs))
-    while done_ops < n_ops or pending:
-        choices = []
-        if done_ops < n_ops and free:
-            choices.append("invoke")
-        unlin = [p for p, d in pending.items() if not d["lin"]]
-        lin = [p for p, d in pending.items() if d["lin"]]
-        if unlin:
-            choices.append("linearize")
-            choices.append("crash_unapplied")
-        if lin:
-            choices.append("complete")
-            choices.append("crash_applied")
-        if not choices:  # every process crashed before n_ops were issued
-            break
-        act = rng.choice(choices)
-        if act == "invoke":
-            p = free.pop(rng.randrange(len(free)))
-            if model_kind == "register":
-                f = rng.choice(["read", "write", "cas"])
-                if f == "read":
-                    value = None
-                elif f == "write":
-                    value = rng.randrange(value_range)
-                else:
-                    value = (rng.randrange(value_range), rng.randrange(value_range))
-            else:
-                f = rng.choice(["read", "add", "add-and-get"])
-                value = None if f == "read" else rng.randrange(1, value_range + 1)
-            pending[p] = {"f": f, "value": value, "lin": False, "result": None}
-            rows.append((p, INVOKE, f, value))
-            done_ops += 1
-        elif act == "linearize":
-            p = rng.choice(unlin)
-            d = pending[p]
-            f, v = d["f"], d["value"]
-            if model_kind == "register":
-                if f == "read":
-                    d["result"] = state
-                elif f == "write":
-                    state = v
-                    d["result"] = None
-                else:
-                    frm, to = v
-                    if state == frm:
-                        state = to
-                        d["result"] = True
-                    else:
-                        d["result"] = False
-            else:
-                if f == "read":
-                    d["result"] = state
-                elif f == "add":
-                    state += v
-                    d["result"] = None
-                else:
-                    state += v
-                    d["result"] = (v, state)
-            d["lin"] = True
-        elif act == "complete":
-            p = rng.choice(lin)
-            d = pending.pop(p)
-            f, r = d["f"], d["result"]
-            if model_kind == "register" and f == "cas" and r is False:
-                rows.append((p, FAIL, f, d["value"]))
-            elif f == "read":
-                rows.append((p, OK, f, r))
-            elif f == "add-and-get":
-                rows.append((p, OK, f, r))
-            else:
-                rows.append((p, OK, f, d["value"]))
-            free.append(p)
-        else:  # crash (applied or not): completion unknown, process retires
-            p = rng.choice(lin if act == "crash_applied" else unlin)
-            d = pending.pop(p)
-            if rng.random() < 0.5:
-                rows.append((p, INFO, d["f"], d["value"]))
-            # else: no completion row at all — pair_ops treats the dangling
-            # invocation as a crashed (info) op, same as jepsen.
-    return H(*rows)
-
-
-def corrupt(rng: random.Random, hist: History) -> History:
-    """Randomly perturb one completion value (may or may not break
-    linearizability — the oracle decides)."""
-    rows = [(o.process, o.type, o.f, o.value) for o in hist]
-    idxs = [i for i, r in enumerate(rows) if r[1] == OK]
-    if not idxs:
-        return hist
-    i = rng.choice(idxs)
-    p, t, f, v = rows[i]
-    if f in ("read",) :
-        v = (v if isinstance(v, int) and v is not None else 0) + rng.choice([1, -1])
-    elif f == "add-and-get" and v is not None:
-        v = (v[0], v[1] + rng.choice([1, -1]))
-    elif f == "write":
-        pass  # write completions carry the written value; leave
-    rows[i] = (p, t, f, v)
-    return H(*rows)
+def H(*rows):
+    return build_history(rows)
