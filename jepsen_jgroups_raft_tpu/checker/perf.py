@@ -26,9 +26,22 @@ def _quantile(sorted_xs: List[float], q: float) -> float:
 
 
 class PerfChecker(Checker):
-    def __init__(self, bucket_s: float = 1.0, render: bool = True):
+    def __init__(self, bucket_s: float = 1.0, render: bool = True,
+                 nemeses: Optional[List[dict]] = None):
+        """`nemeses`: perf annotations from nemesis packages —
+        {"name", "start": set, "stop": set, "color"} — the reference's
+        colored nemesis intervals (membership.clj:158-161). Defaults to
+        the stock fault vocabulary (FAULT_HEALS)."""
         self.bucket_s = bucket_s
         self.render = render
+        self.heals = dict(FAULT_HEALS)
+        self.colors: dict = {}
+        for spec in nemeses or []:
+            for s in spec.get("start", ()):
+                for e in spec.get("stop", ()):
+                    self.heals[s] = e
+                if spec.get("color"):
+                    self.colors[s] = spec["color"]
 
     def check(self, test, history, opts=None) -> dict:
         if not isinstance(history, History):
@@ -50,7 +63,7 @@ class PerfChecker(Checker):
             rate.setdefault(p.completion.type, {})
             rate[p.completion.type][b] = rate[p.completion.type].get(b, 0) + 1
 
-        nemesis_windows = _nemesis_windows(history)
+        nemesis_windows = _nemesis_windows(history, self.heals)
         out = {"valid?": True, "latency": {}, "rate": {}}
         for f, lats in lat_by_f.items():
             lats.sort()
@@ -71,7 +84,8 @@ class PerfChecker(Checker):
         if self.render and store_dir:
             try:
                 path = Path(store_dir) / "latency.svg"
-                path.write_text(_latency_svg(points, nemesis_windows))
+                path.write_text(
+                    _latency_svg(points, nemesis_windows, colors=self.colors))
                 out["plot"] = str(path)
             except Exception:  # plotting must never fail a run
                 pass
@@ -84,7 +98,7 @@ class PerfChecker(Checker):
 FAULT_HEALS = {
     "start-partition": "stop-partition",
     "pause": "resume",
-    "kill": "start",
+    "kill": "restart",
     "shrink": "grow",
 }
 
@@ -107,6 +121,13 @@ def _nemesis_windows(history: History,
         if seen[f] % 2 == 1:
             continue  # invocation record; windows anchor on completions
         if f in starters and f not in open_at:
+            # A refused/failed fault (guardrail refusal string, {"error"}
+            # value, errored op) injected nothing: no window.
+            failed = (op.error is not None
+                      or isinstance(op.value, str)
+                      or (isinstance(op.value, dict) and "error" in op.value))
+            if failed:
+                continue
             open_at[f] = op.time
         elif f in stoppers:
             started = open_at.pop(stoppers[f], None)
@@ -121,8 +142,10 @@ def _nemesis_windows(history: History,
 _TYPE_COLOR = {OK: "#2a7", INFO: "#fa0", "fail": "#d33"}
 
 
-def _latency_svg(points, windows, w: int = 900, h: int = 360) -> str:
+def _latency_svg(points, windows, w: int = 900, h: int = 360,
+                 colors: Optional[dict] = None) -> str:
     """Scatter of op latency over time, log-y, nemesis windows shaded."""
+    colors = colors or {}
     if not points:
         return "<svg xmlns='http://www.w3.org/2000/svg'/>"
     tmax = max(p[0] for p in points) or 1.0
@@ -147,10 +170,11 @@ def _latency_svg(points, windows, w: int = 900, h: int = 360) -> str:
     ]
     for win in windows:
         end = win["end"] if win["end"] is not None else tmax
+        fill = colors.get(win["f"], "#f6c")
         parts.append(
             f"<rect x='{x(win['start']):.1f}' y='{pad}' "
             f"width='{max(1.0, x(end) - x(win['start'])):.1f}' "
-            f"height='{h - 2 * pad}' fill='#f6c' opacity='0.15'/>")
+            f"height='{h - 2 * pad}' fill='{fill}' opacity='0.15'/>")
     for t, lat, f, typ in points:
         parts.append(
             f"<circle cx='{x(t):.1f}' cy='{y(lat):.1f}' r='1.6' "

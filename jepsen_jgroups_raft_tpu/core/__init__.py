@@ -5,5 +5,7 @@ worker threads + the nemesis thread from a generator, records the history,
 runs the composed checker, and persists results (SURVEY.md §3.1).
 """
 
+from .compose import compose_test  # noqa: F401
+from .db import DB, InMemoryDB, InMemoryNet, Net  # noqa: F401
 from .runner import run_test, Scheduler  # noqa: F401
 from .store import save_test, store_root  # noqa: F401

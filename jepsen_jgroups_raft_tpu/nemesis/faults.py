@@ -1,0 +1,139 @@
+"""Partition / kill / pause nemeses.
+
+Equivalents of the jepsen.nemesis.combined partition-package and db-package
+nemeses the reference composes (nemesis.clj:31-46). Each nemesis resolves
+its victim class at invoke time (op.value carries the target kind) using
+the DB's current primaries — matching how the combined packages re-probe
+leaders per fault.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..history.ops import Op
+from .base import Nemesis
+from .targets import partition_grudge, pick_nodes
+
+
+def _member_nodes(test) -> list:
+    """Current live membership — fault targeting follows the shared
+    membership set, not the static node list (raft.clj:70)."""
+    if test.get("members"):
+        return sorted(test["members"])
+    return list(test["nodes"])
+
+
+class PartitionNemesis(Nemesis):
+    """start-partition / stop-partition via the Net boundary."""
+
+    fs = ("start-partition", "stop-partition")
+
+    def __init__(self, net, db=None, seed: Optional[int] = None):
+        self.net = net
+        self.db = db
+        self.rng = random.Random(seed)
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "start-partition":
+            kind = op.value or "majority"
+            nodes = _member_nodes(test)
+            primaries = self.db.primaries(test) if self.db else []
+            grudge = partition_grudge(kind, nodes, primaries, self.rng)
+            self.net.partition(test, grudge)
+            cut = {n: sorted(g) for n, g in grudge.items() if g}
+            return op.replace(value={"kind": kind, "grudge": cut})
+        if op.f == "stop-partition":
+            self.net.heal(test)
+            return op.replace(value="healed")
+        raise ValueError(f"partition nemesis: unknown f {op.f!r}")
+
+    def teardown(self, test):
+        # Never leave the network cut after a run.
+        try:
+            self.net.heal(test)
+        except Exception:
+            pass
+
+
+class KillNemesis(Nemesis):
+    """kill / restart via the DB's Kill protocol (db/kill! + db/start!,
+    reference server.clj:198-218). `restart` restarts everything the
+    nemesis killed (and, with value "all", every node — the final-generator
+    heal)."""
+
+    fs = ("kill", "restart")
+
+    def __init__(self, db, seed: Optional[int] = None):
+        self.db = db
+        self.rng = random.Random(seed)
+        self.down: set = set()
+
+    def invoke(self, test, op: Op) -> Op:
+        nodes = _member_nodes(test)
+        if op.f == "kill":
+            kind = op.value or "one"
+            victims = pick_nodes(kind, nodes, self.db.primaries(test),
+                                 self.rng)
+            for n in victims:
+                self.db.kill(test, n)
+                self.down.add(n)
+            return op.replace(value={"kind": kind, "killed": victims})
+        if op.f == "restart":
+            targets = nodes if op.value == "all" else sorted(self.down)
+            restarted = []
+            for n in targets:
+                self.db.start(test, n)
+                self.down.discard(n)
+                restarted.append(n)
+            return op.replace(value={"restarted": restarted})
+        raise ValueError(f"kill nemesis: unknown f {op.f!r}")
+
+    def teardown(self, test):
+        for n in sorted(self.down):
+            try:
+                self.db.start(test, n)
+            except Exception:
+                pass
+        self.down.clear()
+
+
+class PauseNemesis(Nemesis):
+    """pause / resume via the DB's Pause protocol (SIGSTOP/SIGCONT,
+    reference server.clj:221-222)."""
+
+    fs = ("pause", "resume")
+
+    def __init__(self, db, seed: Optional[int] = None):
+        self.db = db
+        self.rng = random.Random(seed)
+        self.paused: set = set()
+
+    def invoke(self, test, op: Op) -> Op:
+        nodes = _member_nodes(test)
+        if op.f == "pause":
+            kind = op.value or "one"
+            victims = pick_nodes(kind, nodes, self.db.primaries(test),
+                                 self.rng)
+            for n in victims:
+                self.db.pause(test, n)
+                self.paused.add(n)
+            return op.replace(value={"kind": kind, "paused": victims})
+        if op.f == "resume":
+            targets = nodes if op.value == "all" else sorted(self.paused)
+            resumed = []
+            for n in targets:
+                self.db.resume(test, n)
+                self.paused.discard(n)
+                resumed.append(n)
+            return op.replace(value={"resumed": resumed})
+        raise ValueError(f"pause nemesis: unknown f {op.f!r}")
+
+    def teardown(self, test):
+        for n in sorted(self.paused):
+            try:
+                self.db.resume(test, n)
+            except Exception:
+                pass
+        self.paused.clear()

@@ -1,0 +1,90 @@
+"""Membership nemesis: grow/shrink the cluster at runtime.
+
+Equivalent of the reference's nemesis/membership.clj — resize the cluster
+"as a human operator would": issue a consensus add/remove through a live
+member, update the shared membership set, and start/stop the node's
+process. Guardrails mirrored from the reference:
+
+  * never shrink below a majority of the full node set (membership.clj:37-40,
+    80-81) — removing more would let the remnant lose quorum forever;
+  * kill the node BEFORE removing it (membership.clj:87-92): a live node
+    that processes its own removal can restart and fail to rejoin;
+  * 15 s timeouts around both operations, converted into op values rather
+    than harness crashes (membership.clj:50-51, 75-76, 118-135).
+
+The generator is a staggered shrink/grow flip-flop (membership.clj:105-111);
+the final generator grows the cluster back to full strength
+(membership.clj:142-157).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..generator.base import Generator
+from ..history.ops import Op
+from .base import Nemesis
+
+GROW = "grow"
+SHRINK = "shrink"
+
+
+class MemberNemesis(Nemesis):
+    fs = (GROW, SHRINK)
+
+    def __init__(self, db, seed: Optional[int] = None,
+                 op_timeout: float = 15.0):
+        self.db = db
+        self.rng = random.Random(seed)
+        self.op_timeout = op_timeout
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == GROW:
+                return op.replace(value=self._grow(test))
+            if op.f == SHRINK:
+                return op.replace(value=self._shrink(test))
+        except Exception as e:  # convert failures into op values
+            return op.replace(value={"error": repr(e)})
+        raise ValueError(f"member nemesis: unknown f {op.f!r}")
+
+    def _grow(self, test):
+        members = test["members"]
+        spare = sorted(set(test["nodes"]) - set(members))
+        if not spare:
+            return "cluster is already full"
+        node = self.rng.choice(spare)
+        # Consensus add through a live member, then start the process
+        # (membership.clj:47-70: add first so the joiner is a voting
+        # member by the time it boots).
+        self.db.add_member(test, node)
+        members.add(node)
+        self.db.start(test, node)
+        return {"added": node, "members": sorted(members)}
+
+    def _shrink(self, test):
+        members = test["members"]
+        majority = len(test["nodes"]) // 2 + 1
+        if len(members) - 1 < majority:
+            # membership.clj:37-40: refuse; the remnant could lose quorum.
+            return "will not shrink below majority"
+        node = self.rng.choice(sorted(members))
+        # Kill BEFORE removing (membership.clj:87-92).
+        self.db.kill(test, node)
+        self.db.remove_member(test, node)
+        members.discard(node)
+        return {"removed": node, "members": sorted(members)}
+
+
+class GrowUntilFull(Generator):
+    """Generator: emit grow ops until the membership set is full
+    (membership.clj final generator, bounded by the caller's time limit)."""
+
+    def op(self, test, ctx):
+        if set(test["members"]) >= set(test["nodes"]):
+            return None
+        return {"f": GROW, "value": None}, self
+
+    def update(self, test, ctx, event):
+        return self

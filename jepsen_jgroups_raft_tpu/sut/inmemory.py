@@ -17,6 +17,12 @@ Fault hooks (driven by nemeses or latency plans):
     (definite errors).
   * pause(node)/resume(node)  — ops through paused nodes block until
     resume (client times out; op applies on resume → indefinite).
+  * partition(grudge)/heal()  — ops through nodes that cannot reach a
+    quorum block until heal (client times out; op applies on heal →
+    indefinite); leadership moves to the quorum side; isolated nodes
+    report a stale leader/term view until healed.
+  * add_node/remove_node      — runtime membership change (the member
+    nemesis, reference nemesis/membership.clj).
   * latency spikes            — LatencyPlan.slow_prob makes ops exceed the
     client timeout while still applying.
 """
@@ -58,6 +64,12 @@ class InMemoryCluster:
         self.resume_events = {n: threading.Event() for n in self.nodes}
         for e in self.resume_events.values():
             e.set()  # not paused
+        #: node -> set of nodes it cannot talk to (symmetric by contract).
+        self.grudge: dict = {}
+        #: isolated node -> (leader, term) snapshot from partition time.
+        self.stale_views: dict = {}
+        self.heal_event = threading.Event()
+        self.heal_event.set()  # not partitioned
         self.pool = ThreadPoolExecutor(max_workers=64,
                                        thread_name_prefix="sut")
         self.closed = False
@@ -85,9 +97,69 @@ class InMemoryCluster:
     def resume(self, node: str) -> None:
         self.resume_events[node].set()
 
+    def partition(self, grudge: dict) -> None:
+        """Install a grudge map (node -> unreachable peers). A node that can
+        no longer see a quorum cannot commit: its ops block until heal. If
+        the leader lost quorum, the quorum side elects a new leader; nodes
+        without quorum keep serving their pre-partition leader/term view
+        (stale, but election-safe: old term -> old leader)."""
+        with self.lock:
+            snapshot = (self.leader, self.term)
+            self.grudge = {n: set(g) for n, g in grudge.items()}
+            self.stale_views = {
+                n: snapshot for n in self.nodes if not self._quorum_locked(n)
+            }
+            if self.leader is not None and self.leader in self.stale_views:
+                self._elect_locked()
+            if self.stale_views:
+                self.heal_event.clear()
+            elif self.grudge:
+                # Every node retains quorum (e.g. majorities-ring): no
+                # availability change, but the disruption still triggers
+                # an election round, like a real view change would.
+                self._elect_locked()
+
+    def heal(self) -> None:
+        with self.lock:
+            self.grudge = {}
+            self.stale_views = {}
+            if self.leader is None:
+                self._elect_locked()
+        self.heal_event.set()
+
+    def add_node(self, node: str) -> None:
+        with self.lock:
+            if node in self.nodes:
+                return
+            self.nodes.append(node)
+            # Reuse any prior Event: a server thread from the node's
+            # earlier life may still be blocked on it, and replacing the
+            # object would strand that thread beyond resume/shutdown.
+            ev = self.resume_events.setdefault(node, threading.Event())
+            ev.set()
+
+    def remove_node(self, node: str) -> None:
+        with self.lock:
+            if node not in self.nodes:
+                return
+            self.nodes.remove(node)
+            self.killed.discard(node)
+            self.stale_views.pop(node, None)
+            if self.leader == node:
+                self._elect_locked()
+
+    def _quorum_locked(self, node: str) -> bool:
+        """Can `node` reach a strict majority of the current membership
+        (itself included) under the installed grudge?"""
+        n = len(self.nodes)
+        visible = [m for m in self.nodes
+                   if m == node or m not in self.grudge.get(node, ())]
+        return len(visible) > n // 2
+
     def _elect_locked(self) -> None:
         alive = [n for n in self.nodes
-                 if n not in self.killed and self.resume_events[n].is_set()]
+                 if n not in self.killed and self.resume_events[n].is_set()
+                 and self._quorum_locked(n)]
         self.term += 1
         self.leader = self.rng.choice(alive) if alive else None
 
@@ -103,30 +175,45 @@ class InMemoryCluster:
         self.closed = True
         for e in self.resume_events.values():
             e.set()
+        self.heal_event.set()
         self.pool.shutdown(wait=False, cancel_futures=True)
 
     # ---- server side ----------------------------------------------------
 
-    def _apply(self, node: str, fn):
-        """Simulated server-side execution: latency, pause gate, then the
-        linearization point under the cluster lock."""
+    def _apply(self, node: str, fn, local: bool):
+        """Simulated server-side execution: latency, pause gate, partition
+        gate (consensus ops only), then the linearization point under the
+        cluster lock. `local` ops (leader inspection, dirty reads) skip the
+        quorum requirement — they answer from node-local state, like the
+        reference's LeaderElection SM (SURVEY.md J5) and dirty reads."""
         d = self.plan.base + self.rng.expovariate(1.0 / self.plan.jitter) \
             if self.plan.jitter > 0 else self.plan.base
         if self.plan.slow_prob and self.rng.random() < self.plan.slow_prob:
             d += self.plan.slow_s
         time.sleep(d)
-        self.resume_events[node].wait()
-        with self.lock:
-            if node in self.killed:
-                raise ConnectFailed(f"{node} is down")
-            return fn()
+        ev = self.resume_events.get(node)
+        if ev is not None:
+            ev.wait()
+        while True:
+            if self.closed:
+                raise ConnectFailed("cluster shut down")
+            with self.lock:
+                if node in self.killed:
+                    raise ConnectFailed(f"{node} is down")
+                if node not in self.nodes:
+                    raise ConnectFailed(f"{node} is not a member")
+                if local or self._quorum_locked(node):
+                    return fn()
+            # No quorum: the op waits out the partition (the client will
+            # time out; the op applies on heal — honest indefiniteness).
+            self.heal_event.wait(timeout=0.05)
 
-    def submit(self, node: str, fn, timeout: float):
+    def submit(self, node: str, fn, timeout: float, local: bool = False):
         if self.closed:
             raise ConnectFailed("cluster shut down")
         if node in self.killed:
             raise ConnectFailed(f"{node} is down")
-        fut = self.pool.submit(self._apply, node, fn)
+        fut = self.pool.submit(self._apply, node, fn, local)
         try:
             return fut.result(timeout)
         except FutTimeout:
@@ -151,8 +238,8 @@ class _Conn:
         self.node = node
         self.timeout = timeout
 
-    def _do(self, fn):
-        return self.cluster.submit(self.node, fn, self.timeout)
+    def _do(self, fn, local: bool = False):
+        return self.cluster.submit(self.node, fn, self.timeout, local=local)
 
     def close(self) -> None:
         pass
@@ -165,9 +252,10 @@ class RsmConn(_Conn):
         self._do(lambda: self.cluster.map.__setitem__(key, value))
 
     def get(self, key, quorum: bool = True):
-        # Single-copy: dirty reads equal quorum reads here; the flag is
-        # honored by the native tier (stale replicas exist there).
-        return self._do(lambda: self.cluster.map.get(key))
+        # Single-copy: a dirty read returns the same value as a quorum
+        # read, but it skips the quorum gate — so it still answers from a
+        # partition-isolated node, like the native tier's local reads.
+        return self._do(lambda: self.cluster.map.get(key), local=not quorum)
 
     def cas(self, key, frm, to) -> bool:
         def go():
@@ -210,7 +298,13 @@ class CounterConn(_Conn):
 
 
 class LeaderConn(_Conn):
-    """Leadership inspection: (leader, term) as observed from a node."""
+    """Leadership inspection: (leader, term) as observed from this node —
+    a local metadata read (reference LeaderElection.java:35-44), so an
+    isolated node answers with its stale pre-partition view."""
 
     def inspect(self) -> Tuple[Optional[str], int]:
-        return self._do(lambda: (self.cluster.leader, self.cluster.term))
+        def go():
+            view = self.cluster.stale_views.get(self.node)
+            return view if view is not None else (self.cluster.leader,
+                                                  self.cluster.term)
+        return self._do(go, local=True)
