@@ -15,7 +15,7 @@ Implementations:
 
 from __future__ import annotations
 
-from typing import Iterable, List, Optional
+from typing import List
 
 
 class DB:

@@ -1,0 +1,64 @@
+"""Native tier bindings: build orchestration for the C++ data plane.
+
+The C++ tier (native/src) is the capability equivalent of the reference's
+Java tier (SURVEY.md §2.2): raft_server daemon (Server.java), the three
+state machines, libraftclient.so sync clients (SyncClient.java family), and
+raft_member_cli (the jgroups-raft membership CLI the nemesis shells out to,
+membership.clj:22-35). `ensure_built()` plays the role of the reference's
+build-server! step (server.clj:48-58: uberjar built once on the control
+node, gated so concurrent setups don't race).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+NATIVE_DIR = REPO_ROOT / "native"
+BUILD_DIR = NATIVE_DIR / "build"
+
+SERVER_BIN = BUILD_DIR / "raft_server"
+CLIENT_LIB = BUILD_DIR / "libraftclient.so"
+MEMBER_CLI = BUILD_DIR / "raft_member_cli"
+
+_build_lock = threading.Lock()
+_built = False
+
+
+def _sources_mtime() -> float:
+    src = NATIVE_DIR / "src"
+    times = [p.stat().st_mtime for p in src.glob("*")]
+    times.append((NATIVE_DIR / "Makefile").stat().st_mtime)
+    return max(times)
+
+
+def ensure_built(san: str = "") -> None:
+    """Build the native tier if binaries are missing or stale. Idempotent
+    and serialized (build once per process, like build-server!'s
+    primary-gated single build)."""
+    global _built
+    with _build_lock:
+        if _built and not san:
+            return
+        stale = not (SERVER_BIN.exists() and CLIENT_LIB.exists()
+                     and MEMBER_CLI.exists())
+        if not stale:
+            stale = _sources_mtime() > min(
+                SERVER_BIN.stat().st_mtime, CLIENT_LIB.stat().st_mtime,
+                MEMBER_CLI.stat().st_mtime)
+        if stale or san:
+            env = dict(os.environ)
+            cmd = ["make", "-C", str(NATIVE_DIR)]
+            if san:
+                cmd = ["make", "-C", str(NATIVE_DIR), f"SAN={san}"]
+                subprocess.run(["make", "-C", str(NATIVE_DIR), "clean"],
+                               check=True, capture_output=True)
+            proc = subprocess.run(cmd, env=env, capture_output=True,
+                                  text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+        _built = True

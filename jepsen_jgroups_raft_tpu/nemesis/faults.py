@@ -57,83 +57,88 @@ class PartitionNemesis(Nemesis):
             pass
 
 
-class KillNemesis(Nemesis):
-    """kill / restart via the DB's Kill protocol (db/kill! + db/start!,
-    reference server.clj:198-218). `restart` restarts everything the
-    nemesis killed (and, with value "all", every node — the final-generator
-    heal)."""
+class _DbToggleNemesis(Nemesis):
+    """Shared shape of the DB-protocol fault pairs: a `start_f` op picks
+    victims by target kind and applies `do`; a `stop_f` op applies `undo`
+    to everything still afflicted (or every node, with value "all" — the
+    final-generator heal); teardown undoes any leftovers."""
 
-    fs = ("kill", "restart")
+    start_f = ""
+    stop_f = ""
+    done_key = ""    # op-value key listing newly afflicted nodes
+    undone_key = ""  # op-value key listing healed nodes
 
     def __init__(self, db, seed: Optional[int] = None):
         self.db = db
         self.rng = random.Random(seed)
-        self.down: set = set()
+        self.afflicted: set = set()
+
+    @property
+    def fs(self):  # type: ignore[override]
+        return (self.start_f, self.stop_f)
+
+    def _do(self, test, node):
+        raise NotImplementedError
+
+    def _undo(self, test, node):
+        raise NotImplementedError
 
     def invoke(self, test, op: Op) -> Op:
         nodes = _member_nodes(test)
-        if op.f == "kill":
+        if op.f == self.start_f:
             kind = op.value or "one"
             victims = pick_nodes(kind, nodes, self.db.primaries(test),
                                  self.rng)
             for n in victims:
-                self.db.kill(test, n)
-                self.down.add(n)
-            return op.replace(value={"kind": kind, "killed": victims})
-        if op.f == "restart":
-            targets = nodes if op.value == "all" else sorted(self.down)
-            restarted = []
+                self._do(test, n)
+                self.afflicted.add(n)
+            return op.replace(value={"kind": kind, self.done_key: victims})
+        if op.f == self.stop_f:
+            targets = nodes if op.value == "all" else sorted(self.afflicted)
+            undone = []
             for n in targets:
-                self.db.start(test, n)
-                self.down.discard(n)
-                restarted.append(n)
-            return op.replace(value={"restarted": restarted})
-        raise ValueError(f"kill nemesis: unknown f {op.f!r}")
+                self._undo(test, n)
+                self.afflicted.discard(n)
+                undone.append(n)
+            return op.replace(value={self.undone_key: undone})
+        raise ValueError(f"{self.start_f} nemesis: unknown f {op.f!r}")
 
     def teardown(self, test):
-        for n in sorted(self.down):
+        for n in sorted(self.afflicted):
             try:
-                self.db.start(test, n)
+                self._undo(test, n)
             except Exception:
                 pass
-        self.down.clear()
+        self.afflicted.clear()
 
 
-class PauseNemesis(Nemesis):
+class KillNemesis(_DbToggleNemesis):
+    """kill / restart via the DB's Kill protocol (db/kill! + db/start!,
+    reference server.clj:198-218)."""
+
+    start_f = "kill"
+    stop_f = "restart"
+    done_key = "killed"
+    undone_key = "restarted"
+
+    def _do(self, test, node):
+        self.db.kill(test, node)
+
+    def _undo(self, test, node):
+        self.db.start(test, node)
+
+
+class PauseNemesis(_DbToggleNemesis):
     """pause / resume via the DB's Pause protocol (SIGSTOP/SIGCONT,
     reference server.clj:221-222)."""
 
-    fs = ("pause", "resume")
+    start_f = "pause"
+    stop_f = "resume"
+    done_key = "paused"
+    undone_key = "resumed"
 
-    def __init__(self, db, seed: Optional[int] = None):
-        self.db = db
-        self.rng = random.Random(seed)
-        self.paused: set = set()
+    def _do(self, test, node):
+        self.db.pause(test, node)
 
-    def invoke(self, test, op: Op) -> Op:
-        nodes = _member_nodes(test)
-        if op.f == "pause":
-            kind = op.value or "one"
-            victims = pick_nodes(kind, nodes, self.db.primaries(test),
-                                 self.rng)
-            for n in victims:
-                self.db.pause(test, n)
-                self.paused.add(n)
-            return op.replace(value={"kind": kind, "paused": victims})
-        if op.f == "resume":
-            targets = nodes if op.value == "all" else sorted(self.paused)
-            resumed = []
-            for n in targets:
-                self.db.resume(test, n)
-                self.paused.discard(n)
-                resumed.append(n)
-            return op.replace(value={"resumed": resumed})
-        raise ValueError(f"pause nemesis: unknown f {op.f!r}")
-
-    def teardown(self, test):
-        for n in sorted(self.paused):
-            try:
-                self.db.resume(test, n)
-            except Exception:
-                pass
-        self.paused.clear()
+    def _undo(self, test, node):
+        self.db.resume(test, node)

@@ -20,6 +20,8 @@ the final generator grows the cluster back to full strength
 from __future__ import annotations
 
 import random
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Optional
 
 from ..generator.base import Generator
@@ -38,16 +40,28 @@ class MemberNemesis(Nemesis):
         self.db = db
         self.rng = random.Random(seed)
         self.op_timeout = op_timeout
+        # one worker: membership ops are serial anyway, and an abandoned
+        # (timed-out) op must finish before the next one starts
+        self._pool = ThreadPoolExecutor(1)
 
     def invoke(self, test, op: Op) -> Op:
+        if op.f == GROW:
+            task = self._grow
+        elif op.f == SHRINK:
+            task = self._shrink
+        else:
+            raise ValueError(f"member nemesis: unknown f {op.f!r}")
+        # Bounded like the reference's util/timeout wrappers
+        # (membership.clj:50-51,75-76): a wedged consensus op becomes an
+        # op value, never a stuck nemesis thread.
+        fut = self._pool.submit(task, test)
         try:
-            if op.f == GROW:
-                return op.replace(value=self._grow(test))
-            if op.f == SHRINK:
-                return op.replace(value=self._shrink(test))
+            return op.replace(value=fut.result(self.op_timeout))
+        except FutureTimeout:
+            return op.replace(value={"error": f"timed out after "
+                                              f"{self.op_timeout}s"})
         except Exception as e:  # convert failures into op values
             return op.replace(value={"error": repr(e)})
-        raise ValueError(f"member nemesis: unknown f {op.f!r}")
 
     def _grow(self, test):
         members = test["members"]
@@ -57,7 +71,10 @@ class MemberNemesis(Nemesis):
         node = self.rng.choice(spare)
         # Consensus add through a live member, then start the process
         # (membership.clj:47-70: add first so the joiner is a voting
-        # member by the time it boots).
+        # member by the time it boots). The shared set is only updated
+        # once the add committed; if the subsequent start fails the node
+        # is still a (dead) voting member, so keep it in the set — the
+        # final generator / kill-teardown restarts whatever is listed.
         self.db.add_member(test, node)
         members.add(node)
         self.db.start(test, node)
@@ -72,9 +89,22 @@ class MemberNemesis(Nemesis):
         node = self.rng.choice(sorted(members))
         # Kill BEFORE removing (membership.clj:87-92).
         self.db.kill(test, node)
-        self.db.remove_member(test, node)
+        try:
+            self.db.remove_member(test, node)
+        except Exception:
+            # Roll back the kill: without this, a failed remove leaves a
+            # permanently-dead voting member that no healing path restarts
+            # (GrowUntilFull sees the membership as full).
+            try:
+                self.db.start(test, node)
+            except Exception:
+                pass  # node stays listed; teardown/final-gen retries
+            raise
         members.discard(node)
         return {"removed": node, "members": sorted(members)}
+
+    def teardown(self, test):
+        self._pool.shutdown(wait=False)
 
 
 class GrowUntilFull(Generator):

@@ -1,0 +1,345 @@
+// libraftclient — synchronous client, exported as a C API for Python ctypes.
+//
+// Capability equivalent of the reference's sync client family:
+//   SyncClient.java                  — blocking request/response with UUID
+//                                      correlation (:27,62-69), lazy connect
+//                                      with arithmetic-progression backoff
+//                                      within the timeout budget (:130-152),
+//                                      timeout on every operation (:105-118)
+//   SyncReplicatedStateMachineClient — put/get(quorum)/compareAndSet (:23-52)
+//   SyncReplicatedCounterClient      — get/add/addAndGet/compareAndSet
+//                                      against a named counter (:18-62)
+//   SyncLeaderInspectionClient       — inspect() → [leader, term] (:21-27)
+//
+// Status codes land exactly on the harness error taxonomy
+// (workload/client.clj:6-44 → client/errors.py): TIMEOUT and SOCKET are
+// indefinite, CONNECT / NOT_LEADER / SERVER are definite.
+
+#include <chrono>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+
+#include "common.h"
+#include "wire.h"
+
+using namespace raftnative;
+
+extern "C" {
+
+enum RcStatus {
+  RC_OK = 0,
+  RC_TIMEOUT = 1,     // indefinite: op may have been applied
+  RC_CONNECT = 2,     // definite: never reached a server
+  RC_SOCKET = 3,      // indefinite: connection died mid-request
+  RC_NOT_LEADER = 4,  // definite: rejected without executing
+  RC_SERVER = 5,      // definite: server-side rejection
+  RC_CAS_FAIL = 6,    // CAS precondition failed (definite, op executed)
+};
+
+struct rc_client {
+  std::string host;
+  int port;
+  int timeout_ms;
+  int fd = -1;
+  std::string last_error;
+  std::mt19937_64 rng{std::random_device{}()};
+};
+
+rc_client* rc_create(const char* host, int port, int timeout_ms) {
+  auto* c = new rc_client();
+  c->host = host;
+  c->port = port;
+  c->timeout_ms = timeout_ms;
+  return c;
+}
+
+void rc_destroy(rc_client* c) {
+  if (!c) return;
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+const char* rc_last_error(rc_client* c) { return c->last_error.c_str(); }
+
+}  // extern "C"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int64_t remaining_ms(Clock::time_point deadline) {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(deadline -
+                                                               Clock::now())
+      .count();
+}
+
+// Lazy connect with backoff: retry refused connections at increasing
+// intervals until the deadline (SyncClient.java:130-152's
+// arithmetic-progression wait, bounded by the op timeout).
+int ensure_connected(rc_client* c, Clock::time_point deadline) {
+  if (c->fd >= 0) return RC_OK;
+  int attempt = 0;
+  while (true) {
+    int64_t left = remaining_ms(deadline);
+    if (left <= 0) {
+      c->last_error = "connect: timed out";
+      return RC_CONNECT;  // never reached a server: definite
+    }
+    try {
+      c->fd = connect_to(c->host, c->port, static_cast<int>(left));
+      return RC_OK;
+    } catch (const WireError& e) {
+      c->last_error = e.what();
+      if (c->last_error.rfind("refused", 0) != 0 &&
+          c->last_error.rfind("timeout", 0) != 0)
+        return RC_CONNECT;
+      ++attempt;
+      int64_t nap = std::min<int64_t>(100 * attempt, remaining_ms(deadline));
+      if (nap <= 0) return RC_CONNECT;
+      std::this_thread::sleep_for(std::chrono::milliseconds(nap));
+    }
+  }
+}
+
+Bytes fresh_uuid(rc_client* c) {
+  Bytes u(wire::kUuidLen, '\0');
+  uint64_t a = c->rng(), b = c->rng();
+  memcpy(&u[0], &a, 8);
+  memcpy(&u[8], &b, 8);
+  return u;
+}
+
+// One request/response round trip. On success *out holds the response body
+// (after the uuid+ok byte); on server failure the error is decoded.
+int roundtrip(rc_client* c, uint8_t domain, const Bytes& body, Bytes* out) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(c->timeout_ms);
+  int rc = ensure_connected(c, deadline);
+  if (rc != RC_OK) return rc;
+  Bytes uuid = fresh_uuid(c);
+  Buf req;
+  req.raw(uuid);
+  req.u8(domain);
+  req.raw(body);
+  try {
+    send_frame(c->fd, req.s);
+  } catch (const WireError& e) {
+    ::close(c->fd);
+    c->fd = -1;
+    c->last_error = e.what();
+    return RC_SOCKET;  // send failed mid-stream: indefinite
+  }
+  while (true) {
+    int64_t left = remaining_ms(deadline);
+    if (left <= 0) {
+      ::close(c->fd);  // response may still be in flight: drop the conn
+      c->fd = -1;
+      c->last_error = "operation timed out";
+      return RC_TIMEOUT;
+    }
+    set_recv_timeout(c->fd, static_cast<int>(left));
+    Bytes frame;
+    try {
+      if (!recv_frame(c->fd, &frame)) throw WireError("server closed");
+    } catch (const WireError& e) {
+      ::close(c->fd);
+      c->fd = -1;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        c->last_error = "operation timed out";
+        return RC_TIMEOUT;
+      }
+      c->last_error = e.what();
+      return RC_SOCKET;
+    }
+    if (frame.size() < static_cast<size_t>(wire::kUuidLen) + 1) continue;
+    if (memcmp(frame.data(), uuid.data(), wire::kUuidLen) != 0)
+      continue;  // stale response from an abandoned request
+    Reader r(frame.data() + wire::kUuidLen, frame.size() - wire::kUuidLen);
+    bool ok = r.u8() != 0;
+    if (ok) {
+      *out = r.rest();
+      return RC_OK;
+    }
+    uint8_t kind = r.u8();
+    c->last_error = r.str();
+    if (kind == wire::ERR_NOT_LEADER) return RC_NOT_LEADER;
+    if (kind == wire::ERR_TIMEOUT) return RC_TIMEOUT;
+    return RC_SERVER;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- replicated map (register workload) --------------------------------
+
+int rc_map_put(rc_client* c, uint64_t key, int64_t val) {
+  Buf b;
+  b.u8(wire::MAP_PUT);
+  b.u64(key);
+  b.i64(val);
+  Bytes out;
+  return roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+}
+
+int rc_map_get(rc_client* c, uint64_t key, int quorum, int64_t* val,
+               int* found) {
+  Buf b;
+  b.u8(wire::MAP_GET);
+  b.u64(key);
+  b.u8(quorum ? 1 : 0);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  *found = r.u8();
+  *val = r.i64();
+  return RC_OK;
+}
+
+int rc_map_cas(rc_client* c, uint64_t key, int64_t from, int64_t to) {
+  Buf b;
+  b.u8(wire::MAP_CAS);
+  b.u64(key);
+  b.i64(from);
+  b.i64(to);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  return r.u8() ? RC_OK : RC_CAS_FAIL;
+}
+
+// ---- replicated counter ------------------------------------------------
+
+int rc_counter_get(rc_client* c, const char* name, int quorum, int64_t* val) {
+  Buf b;
+  b.u8(wire::CTR_GET);
+  b.str(name);
+  b.u8(quorum ? 1 : 0);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  *val = r.i64();
+  return RC_OK;
+}
+
+int rc_counter_add(rc_client* c, const char* name, int64_t delta) {
+  Buf b;
+  b.u8(wire::CTR_ADD);
+  b.str(name);
+  b.i64(delta);
+  Bytes out;
+  return roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+}
+
+int rc_counter_add_get(rc_client* c, const char* name, int64_t delta,
+                       int64_t* val) {
+  Buf b;
+  b.u8(wire::CTR_ADD_AND_GET);
+  b.str(name);
+  b.i64(delta);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  *val = r.i64();
+  return RC_OK;
+}
+
+int rc_counter_cas(rc_client* c, const char* name, int64_t expect,
+                   int64_t update) {
+  Buf b;
+  b.u8(wire::CTR_CAS);
+  b.str(name);
+  b.i64(expect);
+  b.i64(update);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  return r.u8() ? RC_OK : RC_CAS_FAIL;
+}
+
+// ---- leader inspection -------------------------------------------------
+
+int rc_inspect(rc_client* c, char* leader_buf, int buflen, int64_t* term) {
+  Buf b;
+  b.u8(wire::ELE_INSPECT);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_SM, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  std::string leader = r.str();
+  *term = static_cast<int64_t>(r.u64());
+  snprintf(leader_buf, static_cast<size_t>(buflen), "%s", leader.c_str());
+  return RC_OK;
+}
+
+// ---- admin: probe / membership / partition hook ------------------------
+
+int rc_admin_probe(rc_client* c, char* leader_buf, int buflen, int64_t* term) {
+  Buf b;
+  b.u8(wire::ADM_PROBE);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_ADMIN, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  std::string leader = r.str();
+  *term = static_cast<int64_t>(r.u64());
+  snprintf(leader_buf, static_cast<size_t>(buflen), "%s", leader.c_str());
+  return RC_OK;
+}
+
+int rc_admin_add(rc_client* c, const char* member_spec) {
+  Buf b;
+  b.u8(wire::ADM_ADD);
+  b.str(member_spec);
+  Bytes out;
+  return roundtrip(c, wire::DOMAIN_ADMIN, b.s, &out);
+}
+
+int rc_admin_remove(rc_client* c, const char* name) {
+  Buf b;
+  b.u8(wire::ADM_REMOVE);
+  b.str(name);
+  Bytes out;
+  return roundtrip(c, wire::DOMAIN_ADMIN, b.s, &out);
+}
+
+int rc_admin_block(rc_client* c, const char* names_csv) {
+  Buf b;
+  b.u8(wire::ADM_BLOCK);
+  b.str(names_csv);
+  Bytes out;
+  return roundtrip(c, wire::DOMAIN_ADMIN, b.s, &out);
+}
+
+int rc_admin_unblock(rc_client* c) {
+  Buf b;
+  b.u8(wire::ADM_UNBLOCK);
+  Bytes out;
+  return roundtrip(c, wire::DOMAIN_ADMIN, b.s, &out);
+}
+
+int rc_admin_members(rc_client* c, char* buf, int buflen) {
+  Buf b;
+  b.u8(wire::ADM_MEMBERS);
+  Bytes out;
+  int rc = roundtrip(c, wire::DOMAIN_ADMIN, b.s, &out);
+  if (rc != RC_OK) return rc;
+  Reader r(out);
+  uint32_t n = r.u32();
+  std::string joined;
+  for (uint32_t i = 0; i < n; ++i) {
+    if (i) joined += ",";
+    joined += r.str();
+  }
+  snprintf(buf, static_cast<size_t>(buflen), "%s", joined.c_str());
+  return RC_OK;
+}
+
+}  // extern "C"

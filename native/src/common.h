@@ -1,0 +1,288 @@
+// Shared primitives for the native tier: binary serialization, length-framed
+// TCP io, socket helpers, member-spec parsing.
+//
+// Capability equivalent of the reference's wire layer
+// (java/org/jgroups/raft/data/Request.java, Response.java and the JGroups
+// TcpServer/TcpClient framing used by Server.java:141-142 and
+// SyncClient.java:58): length-prefixed frames carrying UUID-correlated
+// request/response payloads. The encoding here is our own (big-endian
+// fixed-width ints + u32-prefixed strings), not a copy of JGroups'.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace raftnative {
+
+using Bytes = std::string;
+
+struct WireError : std::runtime_error {
+  explicit WireError(const std::string& m) : std::runtime_error(m) {}
+};
+
+// ---------------------------------------------------------------- encoding
+
+struct Buf {
+  Bytes s;
+  void u8(uint8_t v) { s.push_back(static_cast<char>(v)); }
+  void u16(uint16_t v) {
+    u8(static_cast<uint8_t>(v >> 8));
+    u8(static_cast<uint8_t>(v));
+  }
+  void u32(uint32_t v) {
+    u16(static_cast<uint16_t>(v >> 16));
+    u16(static_cast<uint16_t>(v));
+  }
+  void u64(uint64_t v) {
+    u32(static_cast<uint32_t>(v >> 32));
+    u32(static_cast<uint32_t>(v));
+  }
+  void i64(int64_t v) { u64(static_cast<uint64_t>(v)); }
+  void str(const std::string& v) {
+    u32(static_cast<uint32_t>(v.size()));
+    s.append(v);
+  }
+  void raw(const std::string& v) { s.append(v); }
+};
+
+struct Reader {
+  const char* p;
+  size_t n;
+  size_t off = 0;
+  explicit Reader(const Bytes& b) : p(b.data()), n(b.size()) {}
+  Reader(const char* d, size_t len) : p(d), n(len) {}
+  void need(size_t k) const {
+    if (off + k > n) throw WireError("short read in payload");
+  }
+  uint8_t u8() {
+    need(1);
+    return static_cast<uint8_t>(p[off++]);
+  }
+  uint16_t u16() {
+    uint16_t hi = u8();
+    return static_cast<uint16_t>((hi << 8) | u8());
+  }
+  uint32_t u32() {
+    uint32_t hi = u16();
+    return (hi << 16) | u16();
+  }
+  uint64_t u64() {
+    uint64_t hi = u32();
+    return (hi << 32) | u32();
+  }
+  int64_t i64() { return static_cast<int64_t>(u64()); }
+  std::string str() {
+    uint32_t len = u32();
+    need(len);
+    std::string out(p + off, len);
+    off += len;
+    return out;
+  }
+  std::string rest() {
+    std::string out(p + off, n - off);
+    off = n;
+    return out;
+  }
+  bool done() const { return off >= n; }
+};
+
+// ---------------------------------------------------------------- framing
+
+// Read exactly n bytes; false on orderly EOF before any byte, throws on error.
+inline bool read_exact(int fd, char* out, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, out + got, n - got, 0);
+    if (r == 0) {
+      if (got == 0) return false;
+      throw WireError("connection closed mid-frame");
+    }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      throw WireError(std::string("recv: ") + strerror(errno));
+    }
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline void write_all(int fd, const char* data, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, data + sent, n - sent, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      throw WireError(std::string("send: ") + strerror(errno));
+    }
+    sent += static_cast<size_t>(r);
+  }
+}
+
+constexpr uint32_t kMaxFrame = 16u << 20;  // 16 MiB sanity cap
+
+inline void send_frame(int fd, const Bytes& payload) {
+  if (payload.size() > kMaxFrame) throw WireError("frame too large");
+  char hdr[4];
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  memcpy(hdr, &len, 4);
+  write_all(fd, hdr, 4);
+  write_all(fd, payload.data(), payload.size());
+}
+
+// Returns false on orderly EOF at a frame boundary.
+inline bool recv_frame(int fd, Bytes* out) {
+  char hdr[4];
+  if (!read_exact(fd, hdr, 4)) return false;
+  uint32_t len;
+  memcpy(&len, hdr, 4);
+  len = ntohl(len);
+  if (len > kMaxFrame) throw WireError("oversized frame");
+  out->resize(len);
+  if (len && !read_exact(fd, &(*out)[0], len))
+    throw WireError("connection closed mid-frame");
+  return true;
+}
+
+// ---------------------------------------------------------------- sockets
+
+inline int listen_on(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw WireError("socket() failed");
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (host.empty() || host == "0.0.0.0") {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    throw WireError("bad bind address: " + host);
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    throw WireError("bind " + host + ":" + std::to_string(port) + ": " +
+                    strerror(errno));
+  }
+  if (::listen(fd, 128) < 0) {
+    ::close(fd);
+    throw WireError("listen() failed");
+  }
+  return fd;
+}
+
+// Connect with a deadline; throws WireError("refused: ...") on ECONNREFUSED so
+// callers can distinguish the definite-failure case (reference
+// workload/client.clj:21-23 treats ConnectException as definite).
+inline int connect_to(const std::string& host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string portstr = std::to_string(port);
+  if (getaddrinfo(host.c_str(), portstr.c_str(), &hints, &res) != 0 || !res)
+    throw WireError("resolve failed: " + host);
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    throw WireError("socket() failed");
+  }
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc < 0 && errno != EINPROGRESS) {
+    int err = errno;
+    ::close(fd);
+    if (err == ECONNREFUSED) throw WireError("refused: connection refused");
+    throw WireError(std::string("connect: ") + strerror(err));
+  }
+  if (rc < 0) {
+    pollfd pfd{fd, POLLOUT, 0};
+    int pr = ::poll(&pfd, 1, timeout_ms);
+    if (pr <= 0) {
+      ::close(fd);
+      throw WireError("timeout: connect timed out");
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if (err != 0) {
+      ::close(fd);
+      if (err == ECONNREFUSED) throw WireError("refused: connection refused");
+      throw WireError(std::string("connect: ") + strerror(err));
+    }
+  }
+  fcntl(fd, F_SETFL, flags);  // back to blocking
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+inline void set_recv_timeout(int fd, int timeout_ms) {
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+// ---------------------------------------------------------------- members
+
+// A member spec is "name=host:client_port:peer_port". The reference passes a
+// bare node list and hardcodes port 9000 (server.clj:124,143,160); we carry
+// explicit ports so many nodes can share one machine.
+struct MemberSpec {
+  std::string name;
+  std::string host;
+  int client_port = 0;
+  int peer_port = 0;
+
+  std::string to_string() const {
+    return name + "=" + host + ":" + std::to_string(client_port) + ":" +
+           std::to_string(peer_port);
+  }
+
+  static MemberSpec parse(const std::string& spec) {
+    MemberSpec m;
+    auto eq = spec.find('=');
+    if (eq == std::string::npos) throw WireError("bad member spec: " + spec);
+    m.name = spec.substr(0, eq);
+    std::string rest = spec.substr(eq + 1);
+    auto c1 = rest.find(':');
+    auto c2 = rest.find(':', c1 == std::string::npos ? 0 : c1 + 1);
+    if (c1 == std::string::npos || c2 == std::string::npos)
+      throw WireError("bad member spec: " + spec);
+    m.host = rest.substr(0, c1);
+    m.client_port = std::stoi(rest.substr(c1 + 1, c2 - c1 - 1));
+    m.peer_port = std::stoi(rest.substr(c2 + 1));
+    return m;
+  }
+};
+
+inline std::vector<MemberSpec> parse_members(const std::string& csv) {
+  std::vector<MemberSpec> out;
+  size_t pos = 0;
+  while (pos <= csv.size()) {
+    auto comma = csv.find(',', pos);
+    std::string item = csv.substr(
+        pos, comma == std::string::npos ? std::string::npos : comma - pos);
+    if (!item.empty()) out.push_back(MemberSpec::parse(item));
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
+  }
+  return out;
+}
+
+}  // namespace raftnative

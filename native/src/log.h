@@ -1,0 +1,162 @@
+// File-based Raft log + persisted vote metadata.
+//
+// Capability equivalent of the reference SUT's
+// log_class="org.jgroups.protocols.raft.FileBasedLog" log_dir="/tmp"
+// (server/resources/raft.xml:59-61): entries survive process kill, which is
+// what turns the :kill nemesis into a crash-RECOVERY test (SURVEY.md §5.4).
+//
+// Layout under <dir>/<name>/:
+//   meta    — current_term u64 | voted_for str   (atomic tmp+rename rewrite)
+//   log     — append-only records: u32 len | u64 term | u8 type | data
+// Conflict truncation rewrites the log file (rare; fine at harness scale).
+// Indexing is 1-based like the Raft paper; index 0 = empty-log sentinel.
+#pragma once
+
+#include <cstdio>
+#include <sys/stat.h>
+
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace raftnative {
+
+struct LogEntry {
+  uint64_t term = 0;
+  uint8_t type = 0;
+  Bytes data;
+};
+
+class RaftLog {
+ public:
+  // In-memory only when dir is empty (used by unit-scale tests).
+  void open(const std::string& dir, const std::string& name) {
+    if (dir.empty()) return;
+    dir_ = dir + "/" + name;
+    ::mkdir(dir.c_str(), 0755);
+    ::mkdir(dir_.c_str(), 0755);
+    load_meta();
+    load_entries();
+  }
+
+  uint64_t current_term() const { return current_term_; }
+  const std::string& voted_for() const { return voted_for_; }
+
+  void set_term_vote(uint64_t term, const std::string& voted_for) {
+    current_term_ = term;
+    voted_for_ = voted_for;
+    persist_meta();
+  }
+
+  uint64_t last_index() const { return entries_.size(); }
+  uint64_t term_at(uint64_t index) const {
+    if (index == 0 || index > entries_.size()) return 0;
+    return entries_[index - 1].term;
+  }
+  const LogEntry& at(uint64_t index) const { return entries_[index - 1]; }
+
+  uint64_t append(LogEntry e) {
+    entries_.push_back(std::move(e));
+    persist_append(entries_.back());
+    return entries_.size();
+  }
+
+  // Drop every entry with index >= from_index (conflict resolution).
+  void truncate_from(uint64_t from_index) {
+    if (from_index > entries_.size()) return;
+    entries_.resize(from_index - 1);
+    rewrite();
+  }
+
+ private:
+  std::vector<LogEntry> entries_;
+  uint64_t current_term_ = 0;
+  std::string voted_for_;
+  std::string dir_;  // empty → ephemeral
+
+  std::string meta_path() const { return dir_ + "/meta"; }
+  std::string log_path() const { return dir_ + "/log"; }
+
+  void persist_meta() {
+    if (dir_.empty()) return;
+    Buf b;
+    b.u64(current_term_);
+    b.str(voted_for_);
+    std::string tmp = meta_path() + ".tmp";
+    {
+      std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
+      f.write(b.s.data(), static_cast<std::streamsize>(b.s.size()));
+    }
+    ::rename(tmp.c_str(), meta_path().c_str());
+  }
+
+  void load_meta() {
+    std::ifstream f(meta_path(), std::ios::binary);
+    if (!f) return;
+    std::string all((std::istreambuf_iterator<char>(f)),
+                    std::istreambuf_iterator<char>());
+    try {
+      Reader r(all);
+      current_term_ = r.u64();
+      voted_for_ = r.str();
+    } catch (const WireError&) {
+      // torn meta write: keep defaults (term 0) — safe, node just re-votes
+    }
+  }
+
+  static Bytes encode_entry(const LogEntry& e) {
+    Buf rec;
+    rec.u64(e.term);
+    rec.u8(e.type);
+    rec.raw(e.data);
+    Buf framed;
+    framed.u32(static_cast<uint32_t>(rec.s.size()));
+    framed.raw(rec.s);
+    return framed.s;
+  }
+
+  void persist_append(const LogEntry& e) {
+    if (dir_.empty()) return;
+    std::ofstream f(log_path(), std::ios::binary | std::ios::app);
+    Bytes rec = encode_entry(e);
+    f.write(rec.data(), static_cast<std::streamsize>(rec.size()));
+  }
+
+  void rewrite() {
+    if (dir_.empty()) return;
+    std::string tmp = log_path() + ".tmp";
+    {
+      std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
+      for (const auto& e : entries_) {
+        Bytes rec = encode_entry(e);
+        f.write(rec.data(), static_cast<std::streamsize>(rec.size()));
+      }
+    }
+    ::rename(tmp.c_str(), log_path().c_str());
+  }
+
+  void load_entries() {
+    std::ifstream f(log_path(), std::ios::binary);
+    if (!f) return;
+    std::string all((std::istreambuf_iterator<char>(f)),
+                    std::istreambuf_iterator<char>());
+    size_t off = 0;
+    while (off + 4 <= all.size()) {
+      Reader hdr(all.data() + off, 4);
+      uint32_t len = hdr.u32();
+      if (off + 4 + len > all.size()) break;  // torn tail record: drop
+      Reader r(all.data() + off + 4, len);
+      LogEntry e;
+      e.term = r.u64();
+      e.type = r.u8();
+      e.data = r.rest();
+      entries_.push_back(std::move(e));
+      off += 4 + len;
+    }
+  }
+};
+
+}  // namespace raftnative

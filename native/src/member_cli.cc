@@ -1,0 +1,96 @@
+// raft_member_cli — membership administration CLI.
+//
+// Capability equivalent of the upstream jgroups-raft CLI the membership
+// nemesis shells out to: `java -cp server.jar org.jgroups.raft.client.Client
+// -add/-remove <node>` run against an existing member (reference
+// nemesis/membership.clj:22-35). Add/remove are consensus operations: the
+// contacted node forwards them to the leader, which appends a config entry
+// and acks once committed.
+//
+// usage:
+//   raft_member_cli -via host:port -add name=host:cport:pport
+//   raft_member_cli -via host:port -remove name
+//   raft_member_cli -via host:port -members
+//   raft_member_cli -via host:port -probe
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "common.h"
+
+extern "C" {
+struct rc_client;
+rc_client* rc_create(const char* host, int port, int timeout_ms);
+void rc_destroy(rc_client* c);
+const char* rc_last_error(rc_client* c);
+int rc_admin_add(rc_client* c, const char* member_spec);
+int rc_admin_remove(rc_client* c, const char* name);
+int rc_admin_members(rc_client* c, char* buf, int buflen);
+int rc_admin_probe(rc_client* c, char* leader_buf, int buflen, int64_t* term);
+}
+
+int main(int argc, char** argv) {
+  std::string via, add, remove;
+  bool members = false, probe = false;
+  int timeout_ms = 15000;  // the nemesis wraps ops in 15 s (membership.clj:50)
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", a.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "-via")
+      via = next();
+    else if (a == "-add")
+      add = next();
+    else if (a == "-remove")
+      remove = next();
+    else if (a == "-members")
+      members = true;
+    else if (a == "-probe")
+      probe = true;
+    else if (a == "-timeout-ms")
+      timeout_ms = std::stoi(next());
+    else {
+      fprintf(stderr, "unknown flag: %s\n", a.c_str());
+      return 2;
+    }
+  }
+  auto colon = via.rfind(':');
+  if (via.empty() || colon == std::string::npos) {
+    fprintf(stderr, "usage: raft_member_cli -via host:port "
+                    "(-add spec | -remove name | -members | -probe)\n");
+    return 2;
+  }
+  std::string host = via.substr(0, colon);
+  int port = std::stoi(via.substr(colon + 1));
+
+  rc_client* c = rc_create(host.c_str(), port, timeout_ms);
+  int rc = 0;
+  char buf[4096];
+  if (!add.empty()) {
+    rc = rc_admin_add(c, add.c_str());
+    if (rc == 0) printf("added %s\n", add.c_str());
+  } else if (!remove.empty()) {
+    rc = rc_admin_remove(c, remove.c_str());
+    if (rc == 0) printf("removed %s\n", remove.c_str());
+  } else if (members) {
+    rc = rc_admin_members(c, buf, sizeof(buf));
+    if (rc == 0) printf("%s\n", buf);
+  } else if (probe) {
+    int64_t term = 0;
+    rc = rc_admin_probe(c, buf, sizeof(buf), &term);
+    if (rc == 0) printf("leader=%s term=%lld\n", buf, (long long)term);
+  } else {
+    fprintf(stderr, "nothing to do\n");
+    rc_destroy(c);
+    return 2;
+  }
+  if (rc != 0) fprintf(stderr, "error (%d): %s\n", rc, rc_last_error(c));
+  rc_destroy(c);
+  return rc == 0 ? 0 : 1;
+}

@@ -1,0 +1,230 @@
+// Peer-to-peer transport for the Raft plane.
+//
+// Capability equivalent of the reference's JGroups stack role (raft.xml:11-56:
+// transport, discovery, reliable delivery) scoped to what Raft actually needs
+// from it here: best-effort framed messaging between named peers with
+// automatic reconnect — Raft's own retransmission (heartbeat cadence +
+// next_index backup) provides reliability, so a dropped frame is safe.
+//
+// The `block`/`unblock` hooks are the partition-injection boundary: a blocked
+// peer's frames are dropped on BOTH send and receive, which is observably the
+// same bidirectional cut an iptables grudge produces (jepsen.net's partition
+// strategy used via nemesis.clj:36), but injectable per-process on a localhost
+// cluster. Each inbound connection self-identifies with a HELLO frame so
+// receive-side filtering knows the sender.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+
+#include "common.h"
+#include "wire.h"
+
+namespace raftnative {
+
+class Transport {
+ public:
+  // handler(sender_name, msg_type, reader-positioned-after-type)
+  using Handler = std::function<void(const std::string&, uint8_t, Reader&)>;
+
+  void start(const std::string& self_name, const std::string& bind_host,
+             int peer_port, Handler handler) {
+    self_ = self_name;
+    handler_ = std::move(handler);
+    running_ = true;
+    listen_fd_ = listen_on(bind_host, peer_port);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+  }
+
+  void stop() {
+    running_ = false;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& kv : links_) kv.second->stop();
+      links_.clear();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+  }
+
+  ~Transport() {
+    if (running_) stop();
+  }
+
+  void set_address(const std::string& name, const std::string& host,
+                   int port) {
+    if (name == self_) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = links_.find(name);
+    if (it != links_.end()) {
+      if (it->second->host == host && it->second->port == port) return;
+      it->second->stop();
+      links_.erase(it);
+    }
+    auto link = std::make_shared<Link>();
+    link->self = self_;
+    link->peer = name;
+    link->host = host;
+    link->port = port;
+    link->run();
+    links_[name] = std::move(link);
+  }
+
+  void remove_address(const std::string& name) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = links_.find(name);
+    if (it != links_.end()) {
+      it->second->stop();
+      links_.erase(it);
+    }
+  }
+
+  // Enqueue a frame for a peer; silently dropped if unknown or blocked.
+  void send(const std::string& peer, Bytes payload) {
+    std::shared_ptr<Link> link;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (blocked_.count(peer)) return;
+      auto it = links_.find(peer);
+      if (it == links_.end()) return;
+      link = it->second;
+    }
+    link->enqueue(std::move(payload));
+  }
+
+  void block(const std::set<std::string>& peers) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (const auto& p : peers) blocked_.insert(p);
+  }
+
+  void unblock_all() {
+    std::lock_guard<std::mutex> g(mu_);
+    blocked_.clear();
+  }
+
+  bool is_blocked(const std::string& peer) {
+    std::lock_guard<std::mutex> g(mu_);
+    return blocked_.count(peer) > 0;
+  }
+
+ private:
+  // One outbound connection per peer: bounded queue + sender thread with
+  // lazy reconnect. Send failure drops the frame (Raft retries by cadence).
+  struct Link {
+    std::string self, peer, host;
+    int port = 0;
+    std::mutex qmu;
+    std::condition_variable qcv;
+    std::deque<Bytes> queue;
+    std::atomic<bool> alive{false};
+    int fd = -1;
+    std::thread thread;
+    static constexpr size_t kMaxQueue = 4096;
+
+    void run() {
+      alive = true;
+      thread = std::thread([this] { loop(); });
+    }
+
+    void stop() {
+      alive = false;
+      qcv.notify_all();
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+      if (thread.joinable()) thread.detach();  // loop exits on alive=false
+    }
+
+    void enqueue(Bytes payload) {
+      std::lock_guard<std::mutex> g(qmu);
+      if (queue.size() >= kMaxQueue) queue.pop_front();
+      queue.push_back(std::move(payload));
+      qcv.notify_one();
+    }
+
+    void loop() {
+      while (alive) {
+        Bytes frame;
+        {
+          std::unique_lock<std::mutex> g(qmu);
+          qcv.wait_for(g, std::chrono::milliseconds(200),
+                       [this] { return !queue.empty() || !alive; });
+          if (!alive) break;
+          if (queue.empty()) continue;
+          frame = std::move(queue.front());
+          queue.pop_front();
+        }
+        try {
+          if (fd < 0) {
+            fd = connect_to(host, port, 250);
+            Buf hello;
+            hello.u8(wire::P_HELLO);
+            hello.str(self);
+            send_frame(fd, hello.s);
+          }
+          send_frame(fd, frame);
+        } catch (const WireError&) {
+          if (fd >= 0) ::close(fd);
+          fd = -1;  // frame dropped; raft cadence re-sends
+        }
+      }
+      if (fd >= 0) ::close(fd);
+    }
+  };
+
+  void accept_loop() {
+    while (running_) {
+      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) {
+        if (!running_) break;
+        continue;
+      }
+      std::thread([this, cfd] { reader_loop(cfd); }).detach();
+    }
+  }
+
+  void reader_loop(int cfd) {
+    std::string sender;
+    try {
+      Bytes frame;
+      while (running_ && recv_frame(cfd, &frame)) {
+        Reader r(frame);
+        uint8_t type = r.u8();
+        if (type == wire::P_HELLO) {
+          sender = r.str();
+          continue;
+        }
+        if (sender.empty()) break;  // protocol violation
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          if (blocked_.count(sender)) continue;  // partitioned: drop inbound
+        }
+        handler_(sender, type, r);
+      }
+    } catch (const WireError&) {
+      // connection died; peer reconnects
+    }
+    ::close(cfd);
+  }
+
+  std::string self_;
+  Handler handler_;
+  std::atomic<bool> running_{false};
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::map<std::string, std::shared_ptr<Link>> links_;
+  std::set<std::string> blocked_;
+};
+
+}  // namespace raftnative

@@ -1,0 +1,751 @@
+// Raft consensus core.
+//
+// Capability equivalent of the reference SUT's consensus layer — the
+// jgroups-raft protocols raft.ELECTION / raft.RAFT / raft.REDIRECT /
+// raft.NO_DUPES configured in server/resources/raft.xml:48,57-62 — scoped to
+// what the harness exercises: leader election with randomized timeouts, log
+// replication with commit on majority, crash-recovery from the file-based log
+// (raft.xml:59-61), follower→leader request forwarding (REDIRECT), one-at-a-
+// time membership change via consensus (the raft.CLIENT addServer/removeServer
+// surface, membership.clj:22-35), duplicate-join rejection (NO_DUPES), and
+// linearizable "quorum reads" implemented as read entries through the log
+// (the observable contract of ReplicatedMap.java:65-75's
+// allowDirtyReads(false): a quorum read costs a consensus round).
+//
+// Design: mutex-guarded core state; a 10ms ticker thread drives elections and
+// heartbeats; per-peer sender threads (net.h) do network IO so the core never
+// blocks on a socket; a dedicated apply thread feeds committed entries to the
+// state machine and resolves pending client futures.
+#pragma once
+
+#include <algorithm>
+#include <chrono>
+#include <functional>
+#include <future>
+#include <map>
+#include <memory>
+#include <random>
+#include <set>
+#include <sstream>
+#include <vector>
+
+#include "common.h"
+#include "log.h"
+#include "net.h"
+#include "wire.h"
+
+namespace raftnative {
+
+struct Result {
+  bool ok = false;
+  uint8_t errkind = 0;  // wire::ERR_* when !ok
+  Bytes body;           // response payload | error message
+  static Result success(Bytes b = {}) { return {true, 0, std::move(b)}; }
+  static Result error(uint8_t kind, const std::string& msg) {
+    return {false, kind, msg};
+  }
+};
+
+// Pluggable state-machine boundary — the TestStateMachine.receive contract
+// (java/org/jgroups/raft/server/TestStateMachine.java:8-11): one interface
+// unifying "handle a client request" across all state machines, plus the
+// deterministic apply callback every replica runs on commit.
+class StateMachine {
+ public:
+  using SubmitFn = std::function<Result(const Bytes& op)>;
+  virtual ~StateMachine() = default;
+  // Deterministic application of a committed op payload → response bytes.
+  virtual Bytes apply(const Bytes& op) = 0;
+  // Client-request dispatch. `submit` runs an op through consensus and
+  // blocks for the replicated response (or error).
+  virtual Result receive(const Bytes& body, const SubmitFn& submit) = 0;
+  // Snapshot hooks (upstream readContentFrom/writeContentTo analogue,
+  // LeaderElection.java:52-55); log compaction is not exercised by the
+  // harness, so these only serialize state.
+  virtual void save(std::ostream&) {}
+  virtual void load(std::istream&) {}
+};
+
+class RaftNode {
+ public:
+  struct Options {
+    std::string name;
+    std::string log_dir;  // empty → ephemeral log
+    int election_ms = 300;
+    int heartbeat_ms = 100;
+    int repl_timeout_ms = 30000;  // server repl-timeout analogue (30 s,
+                                  // server/src/jgroups/raft/server.clj:37)
+    std::vector<MemberSpec> initial_members;
+  };
+
+  RaftNode(Options opt, StateMachine* sm, Transport* tr)
+      : opt_(std::move(opt)), sm_(sm), tr_(tr), rng_(std::random_device{}()) {}
+
+  void start() {
+    log_.open(opt_.log_dir, opt_.name);
+    config_ = opt_.initial_members;
+    // Recovered log may contain a newer committed config; adopt the last one.
+    for (uint64_t i = log_.last_index(); i >= 1; --i) {
+      if (log_.at(i).type == wire::E_CONFIG) {
+        config_ = decode_config(log_.at(i).data);
+        break;
+      }
+    }
+    sync_transport_addresses();
+    reset_election_deadline();
+    running_ = true;
+    ticker_ = std::thread([this] { tick_loop(); });
+    applier_ = std::thread([this] { apply_loop(); });
+  }
+
+  void stop() {
+    running_ = false;
+    apply_cv_.notify_all();
+    if (ticker_.joinable()) ticker_.join();
+    if (applier_.joinable()) applier_.join();
+  }
+
+  ~RaftNode() {
+    if (running_) stop();
+  }
+
+  // ---- client-facing surface -------------------------------------------
+
+  // Run one op through consensus (forwarding to the leader if needed) and
+  // block for the result, up to repl_timeout.
+  Result submit(const Bytes& op) { return route(FwdKind::Op, op); }
+
+  Result add_server(const MemberSpec& m) {
+    return route(FwdKind::Add, m.to_string());
+  }
+
+  Result remove_server(const std::string& name) {
+    return route(FwdKind::Remove, name);
+  }
+
+  // Local view of (leader, term) — what the JMX probe RAFT.leader reads
+  // (server.clj:34-39) and what the election workload inspects
+  // (LeaderElection.java:35-44). Never does IO.
+  std::pair<std::string, uint64_t> leader_info() {
+    std::lock_guard<std::mutex> g(mu_);
+    return {role_ == Role::Leader ? opt_.name : leader_hint_,
+            log_.current_term()};
+  }
+
+  std::vector<MemberSpec> members() {
+    std::lock_guard<std::mutex> g(mu_);
+    return config_;
+  }
+
+  const std::string& name() const { return opt_.name; }
+
+  // ---- peer message entry point (called from transport reader threads) --
+
+  void on_peer_msg(const std::string& sender, uint8_t type, Reader& r) {
+    (void)sender;  // messages carry their own sender fields; the transport
+                   // argument exists for receive-side partition filtering
+    switch (type) {
+      case wire::P_VOTE_REQ:
+        handle_vote_req(r);
+        break;
+      case wire::P_VOTE_RESP:
+        handle_vote_resp(r);
+        break;
+      case wire::P_APP_REQ:
+        handle_app_req(r);
+        break;
+      case wire::P_APP_RESP:
+        handle_app_resp(r);
+        break;
+      case wire::P_FWD_REQ: {
+        // Consensus can take a while; never block a transport reader.
+        uint64_t reqid = r.u64();
+        std::string origin = r.str();
+        uint8_t kind = r.u8();
+        Bytes payload = r.str();
+        std::thread([this, reqid, origin, kind, payload] {
+          handle_fwd_req(reqid, origin, kind, payload);
+        }).detach();
+        break;
+      }
+      case wire::P_FWD_RESP:
+        handle_fwd_resp(r);
+        break;
+      default:
+        break;  // unknown message from a newer version: ignore
+    }
+  }
+
+ private:
+  enum class Role { Follower, Candidate, Leader };
+  enum class FwdKind : uint8_t { Op = 0, Add = 1, Remove = 2 };
+
+  using Clock = std::chrono::steady_clock;
+
+  struct Pending {
+    std::promise<Result> promise;
+    uint64_t term;
+  };
+
+  // ---- routing: local submit when leader, else forward -----------------
+
+  Result route(FwdKind kind, const Bytes& payload) {
+    bool am_leader;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      am_leader = (role_ == Role::Leader);
+    }
+    if (am_leader) return leader_execute(kind, payload);
+    return forward(kind, payload);
+  }
+
+  Result leader_execute(FwdKind kind, const Bytes& payload) {
+    switch (kind) {
+      case FwdKind::Op:
+        return submit_local(payload, wire::E_OP);
+      case FwdKind::Add:
+        return change_config(/*add=*/true, payload);
+      case FwdKind::Remove:
+        return change_config(/*add=*/false, payload);
+    }
+    return Result::error(wire::ERR_SERVER, "bad forward kind");
+  }
+
+  Result submit_local(const Bytes& op, uint8_t etype) {
+    std::shared_ptr<Pending> pend;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (role_ != Role::Leader)
+        return Result::error(wire::ERR_NOT_LEADER, "not the leader");
+      uint64_t term = log_.current_term();
+      uint64_t idx = log_.append(LogEntry{term, etype, op});
+      pend = std::make_shared<Pending>();
+      pend->term = term;
+      pending_[idx] = pend;
+      if (etype == wire::E_CONFIG) adopt_config(op);
+      maybe_advance_commit_locked();
+    }
+    broadcast_append();
+    return wait_pending(pend);
+  }
+
+  Result wait_pending(const std::shared_ptr<Pending>& pend) {
+    auto fut = pend->promise.get_future();
+    if (fut.wait_for(std::chrono::milliseconds(opt_.repl_timeout_ms)) !=
+        std::future_status::ready) {
+      // Indefinite: the entry may still commit later. The client taxonomy
+      // maps this to :info (client.clj:14-16 → errors.py ClientTimeout).
+      return Result::error(wire::ERR_TIMEOUT, "replication timed out");
+    }
+    return fut.get();
+  }
+
+  // One-at-a-time membership change. Rejects duplicate joins (the NO_DUPES
+  // capability, raft.xml:48) and a second change while one is in flight.
+  Result change_config(bool add, const Bytes& payload) {
+    Bytes body;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (role_ != Role::Leader)
+        return Result::error(wire::ERR_NOT_LEADER, "not the leader");
+      for (uint64_t i = commit_index_ + 1; i <= log_.last_index(); ++i)
+        if (log_.at(i).type == wire::E_CONFIG)
+          return Result::error(wire::ERR_SERVER,
+                               "a membership change is already in flight");
+      std::vector<MemberSpec> next = config_;
+      if (add) {
+        MemberSpec m = MemberSpec::parse(payload);
+        for (const auto& c : next)
+          if (c.name == m.name)
+            return Result::error(wire::ERR_SERVER,
+                                 "duplicate member: " + m.name);
+        next.push_back(m);
+      } else {
+        size_t before = next.size();
+        next.erase(std::remove_if(next.begin(), next.end(),
+                                  [&](const MemberSpec& c) {
+                                    return c.name == payload;
+                                  }),
+                   next.end());
+        if (next.size() == before)
+          return Result::error(wire::ERR_SERVER, "no such member: " +
+                                                     std::string(payload));
+        if (next.empty())
+          return Result::error(wire::ERR_SERVER, "refusing to empty cluster");
+      }
+      body = encode_config(next);
+    }
+    return submit_local(body, wire::E_CONFIG);
+  }
+
+  // ---- election --------------------------------------------------------
+
+  void tick_loop() {
+    while (running_) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      std::vector<std::pair<std::string, Bytes>> outbox;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        auto now = Clock::now();
+        if (role_ == Role::Leader) {
+          if (now >= next_heartbeat_) {
+            queue_appends_locked(outbox);
+            next_heartbeat_ =
+                now + std::chrono::milliseconds(opt_.heartbeat_ms);
+          }
+        } else if (now >= election_deadline_ && self_in_config_locked()) {
+          start_election_locked(outbox);
+        }
+      }
+      for (auto& [peer, frame] : outbox) tr_->send(peer, std::move(frame));
+    }
+  }
+
+  bool self_in_config_locked() const {
+    for (const auto& m : config_)
+      if (m.name == opt_.name) return true;
+    return false;  // removed members must not disrupt elections
+  }
+
+  void start_election_locked(std::vector<std::pair<std::string, Bytes>>& out) {
+    uint64_t term = log_.current_term() + 1;
+    log_.set_term_vote(term, opt_.name);
+    role_ = Role::Candidate;
+    leader_hint_.clear();
+    votes_.clear();
+    votes_.insert(opt_.name);
+    reset_election_deadline();
+    maybe_win_locked();  // single-node cluster wins instantly
+    Buf b;
+    b.u8(wire::P_VOTE_REQ);
+    b.u64(term);
+    b.str(opt_.name);
+    b.u64(log_.last_index());
+    b.u64(log_.term_at(log_.last_index()));
+    for (const auto& m : config_)
+      if (m.name != opt_.name) out.emplace_back(m.name, b.s);
+  }
+
+  void handle_vote_req(Reader& r) {
+    uint64_t term = r.u64();
+    std::string candidate = r.str();
+    uint64_t last_idx = r.u64();
+    uint64_t last_term = r.u64();
+    Buf resp;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (term > log_.current_term()) step_down_locked(term);
+      bool granted = false;
+      if (term == log_.current_term() &&
+          (log_.voted_for().empty() || log_.voted_for() == candidate)) {
+        // Raft §5.4.1 up-to-date check.
+        uint64_t my_last = log_.last_index();
+        uint64_t my_last_term = log_.term_at(my_last);
+        if (last_term > my_last_term ||
+            (last_term == my_last_term && last_idx >= my_last)) {
+          granted = true;
+          log_.set_term_vote(term, candidate);
+          reset_election_deadline();
+        }
+      }
+      resp.u8(wire::P_VOTE_RESP);
+      resp.u64(log_.current_term());
+      resp.u8(granted ? 1 : 0);
+      resp.str(opt_.name);
+    }
+    tr_->send(candidate, resp.s);
+  }
+
+  void handle_vote_resp(Reader& r) {
+    uint64_t term = r.u64();
+    bool granted = r.u8() != 0;
+    std::string voter = r.str();
+    std::lock_guard<std::mutex> g(mu_);
+    if (term > log_.current_term()) {
+      step_down_locked(term);
+      return;
+    }
+    if (role_ != Role::Candidate || term != log_.current_term() || !granted)
+      return;
+    votes_.insert(voter);
+    maybe_win_locked();
+  }
+
+  void maybe_win_locked() {
+    size_t have = 0;
+    for (const auto& m : config_)
+      if (votes_.count(m.name)) ++have;
+    if (have < majority_locked()) return;
+    role_ = Role::Leader;
+    leader_hint_ = opt_.name;
+    next_index_.clear();
+    match_index_.clear();
+    for (const auto& m : config_) {
+      next_index_[m.name] = log_.last_index() + 1;
+      match_index_[m.name] = 0;
+    }
+    // Term-opening no-op (Raft §8): commits all prior-term entries, which
+    // also makes quorum reads correct from the first client op.
+    log_.append(LogEntry{log_.current_term(), wire::E_NOOP, {}});
+    maybe_advance_commit_locked();
+    next_heartbeat_ = Clock::now();  // heartbeat immediately
+  }
+
+  size_t majority_locked() const { return config_.size() / 2 + 1; }
+
+  void step_down_locked(uint64_t term) {
+    bool was_leader = (role_ == Role::Leader);
+    role_ = Role::Follower;
+    if (term > log_.current_term()) {
+      log_.set_term_vote(term, "");
+      // The hint must only ever name a leader OF THE CURRENT TERM: it is
+      // re-set by the first accepted AppendEntries of the new term. A stale
+      // hint paired with the new term would make inspect() report
+      // (old-leader, new-term) — a false election-safety violation under
+      // the LeaderModel (leader.clj:63-75).
+      leader_hint_.clear();
+    }
+    if (was_leader) fail_pending_locked("lost leadership");
+    reset_election_deadline();
+  }
+
+  void fail_pending_locked(const std::string& why) {
+    // INDEFINITE, not NOT_LEADER: an entry appended by a deposed leader may
+    // have reached a majority and can still commit under the new leader.
+    // Answering "definite failure" here would let the harness record :fail
+    // (checker drops the op) for a write that later takes effect — a
+    // checker-visible linearizability anomaly. ERR_TIMEOUT maps to the
+    // indefinite :info class (client.clj:14-16 semantics).
+    for (auto& [idx, p] : pending_)
+      p->promise.set_value(
+          Result::error(wire::ERR_TIMEOUT, why + "; outcome unknown"));
+    pending_.clear();
+  }
+
+  void reset_election_deadline() {
+    std::uniform_int_distribution<int> jitter(opt_.election_ms,
+                                              2 * opt_.election_ms);
+    election_deadline_ = Clock::now() + std::chrono::milliseconds(jitter(rng_));
+  }
+
+  // ---- replication -----------------------------------------------------
+
+  void broadcast_append() {
+    std::vector<std::pair<std::string, Bytes>> outbox;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (role_ != Role::Leader) return;
+      queue_appends_locked(outbox);
+      next_heartbeat_ =
+          Clock::now() + std::chrono::milliseconds(opt_.heartbeat_ms);
+    }
+    for (auto& [peer, frame] : outbox) tr_->send(peer, std::move(frame));
+  }
+
+  void queue_appends_locked(std::vector<std::pair<std::string, Bytes>>& out) {
+    constexpr uint64_t kMaxBatch = 256;
+    for (const auto& m : config_) {
+      if (m.name == opt_.name) continue;
+      uint64_t next = next_index_.count(m.name) ? next_index_[m.name]
+                                                : log_.last_index() + 1;
+      uint64_t prev = next - 1;
+      uint64_t last = std::min(log_.last_index(), prev + kMaxBatch);
+      Buf b;
+      b.u8(wire::P_APP_REQ);
+      b.u64(log_.current_term());
+      b.str(opt_.name);
+      b.u64(prev);
+      b.u64(log_.term_at(prev));
+      b.u64(commit_index_);
+      b.u32(static_cast<uint32_t>(last >= next ? last - next + 1 : 0));
+      for (uint64_t i = next; i <= last; ++i) {
+        const LogEntry& e = log_.at(i);
+        b.u64(e.term);
+        b.u8(e.type);
+        b.str(e.data);
+      }
+      out.emplace_back(m.name, b.s);
+    }
+  }
+
+  void handle_app_req(Reader& r) {
+    uint64_t term = r.u64();
+    std::string leader = r.str();
+    uint64_t prev_idx = r.u64();
+    uint64_t prev_term = r.u64();
+    uint64_t leader_commit = r.u64();
+    uint32_t count = r.u32();
+    Buf resp;
+    bool notify_apply = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      uint64_t my_term = log_.current_term();
+      bool success = false;
+      uint64_t match = 0;
+      if (term >= my_term) {
+        if (term > my_term || role_ != Role::Follower) step_down_locked(term);
+        leader_hint_ = leader;
+        reset_election_deadline();
+        if (prev_idx <= log_.last_index() &&
+            log_.term_at(prev_idx) == prev_term) {
+          success = true;
+          uint64_t idx = prev_idx;
+          for (uint32_t i = 0; i < count; ++i) {
+            uint64_t eterm = r.u64();
+            uint8_t etype = r.u8();
+            Bytes data = r.str();
+            ++idx;
+            if (idx <= log_.last_index()) {
+              if (log_.term_at(idx) == eterm) continue;  // already have it
+              log_.truncate_from(idx);
+              reconfig_from_log_locked();
+            }
+            log_.append(LogEntry{eterm, etype, data});
+            if (etype == wire::E_CONFIG) adopt_config(data);
+          }
+          match = idx;
+          uint64_t new_commit = std::min(leader_commit, log_.last_index());
+          if (new_commit > commit_index_) {
+            commit_index_ = new_commit;
+            notify_apply = true;
+          }
+        } else {
+          // Log mismatch: hint our last index so the leader jumps next_index
+          // straight past the gap instead of decrementing one at a time.
+          match = log_.last_index();
+        }
+      }
+      resp.u8(wire::P_APP_RESP);
+      resp.u64(log_.current_term());
+      resp.u8(success ? 1 : 0);
+      resp.str(opt_.name);
+      resp.u64(match);
+    }
+    if (notify_apply) apply_cv_.notify_all();
+    tr_->send(leader, resp.s);
+  }
+
+  void handle_app_resp(Reader& r) {
+    uint64_t term = r.u64();
+    bool success = r.u8() != 0;
+    std::string follower = r.str();
+    uint64_t match = r.u64();
+    bool resend = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (term > log_.current_term()) {
+        step_down_locked(term);
+        return;
+      }
+      if (role_ != Role::Leader || term != log_.current_term()) return;
+      if (success) {
+        match_index_[follower] = std::max(match_index_[follower], match);
+        next_index_[follower] = match_index_[follower] + 1;
+        maybe_advance_commit_locked();
+        resend = next_index_[follower] <= log_.last_index();
+      } else {
+        uint64_t next = next_index_.count(follower) ? next_index_[follower]
+                                                    : log_.last_index() + 1;
+        next_index_[follower] = std::max<uint64_t>(
+            1, std::min(next > 1 ? next - 1 : 1, match + 1));
+        resend = true;
+      }
+    }
+    if (resend) broadcast_append();
+  }
+
+  void maybe_advance_commit_locked() {
+    if (role_ != Role::Leader) return;
+    std::vector<uint64_t> matches;
+    for (const auto& m : config_)
+      matches.push_back(m.name == opt_.name ? log_.last_index()
+                                            : match_index_[m.name]);
+    std::sort(matches.begin(), matches.end(), std::greater<uint64_t>());
+    uint64_t cand = matches[majority_locked() - 1];
+    // Raft §5.4.2: only entries of the current term commit by counting.
+    if (cand > commit_index_ && log_.term_at(cand) == log_.current_term()) {
+      commit_index_ = cand;
+      apply_cv_.notify_all();
+    }
+  }
+
+  // ---- apply loop ------------------------------------------------------
+
+  void apply_loop() {
+    while (running_) {
+      std::vector<std::pair<std::shared_ptr<Pending>, Result>> done;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        apply_cv_.wait_for(g, std::chrono::milliseconds(50), [this] {
+          return last_applied_ < commit_index_ || !running_;
+        });
+        while (last_applied_ < commit_index_) {
+          uint64_t idx = ++last_applied_;
+          const LogEntry& e = log_.at(idx);
+          Bytes resp;
+          if (e.type == wire::E_OP) resp = sm_->apply(e.data);
+          auto it = pending_.find(idx);
+          if (it != pending_.end()) {
+            Result res =
+                (it->second->term == e.term)
+                    ? Result::success(std::move(resp))
+                    : Result::error(wire::ERR_NOT_LEADER,
+                                    "entry superseded by another leader");
+            done.emplace_back(it->second, std::move(res));
+            pending_.erase(it);
+          }
+        }
+      }
+      for (auto& [pend, res] : done) pend->promise.set_value(std::move(res));
+    }
+  }
+
+  // ---- membership plumbing ---------------------------------------------
+
+  static Bytes encode_config(const std::vector<MemberSpec>& ms) {
+    Buf b;
+    b.u32(static_cast<uint32_t>(ms.size()));
+    for (const auto& m : ms) b.str(m.to_string());
+    return b.s;
+  }
+
+  static std::vector<MemberSpec> decode_config(const Bytes& data) {
+    Reader r(data);
+    uint32_t n = r.u32();
+    std::vector<MemberSpec> out;
+    for (uint32_t i = 0; i < n; ++i)
+      out.push_back(MemberSpec::parse(r.str()));
+    return out;
+  }
+
+  // Config takes effect at APPEND time (single-server change discipline).
+  void adopt_config(const Bytes& data) {
+    config_ = decode_config(data);
+    sync_transport_addresses();
+  }
+
+  void reconfig_from_log_locked() {
+    config_ = opt_.initial_members;
+    for (uint64_t i = log_.last_index(); i >= 1; --i) {
+      if (log_.at(i).type == wire::E_CONFIG) {
+        config_ = decode_config(log_.at(i).data);
+        break;
+      }
+    }
+    sync_transport_addresses();
+  }
+
+  void sync_transport_addresses() {
+    for (const auto& m : config_)
+      tr_->set_address(m.name, m.host, m.peer_port);
+  }
+
+  // ---- forwarding (REDIRECT analogue) ----------------------------------
+
+ public:
+  // Called by route() when not leader; public-ish for testability.
+  Result forward(FwdKind kind, const Bytes& payload) {
+    std::string leader;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      leader = leader_hint_;
+      if (leader.empty() || leader == opt_.name)
+        return Result::error(wire::ERR_NOT_LEADER, "no known leader");
+    }
+    auto pend = std::make_shared<std::promise<Result>>();
+    uint64_t reqid;
+    {
+      std::lock_guard<std::mutex> g(fwd_mu_);
+      reqid = next_fwd_id_++;
+      fwd_pending_[reqid] = pend;
+    }
+    Buf b;
+    b.u8(wire::P_FWD_REQ);
+    b.u64(reqid);
+    b.str(opt_.name);
+    b.u8(static_cast<uint8_t>(kind));
+    b.str(payload);
+    tr_->send(leader, b.s);
+    auto fut = pend->get_future();
+    Result out;
+    if (fut.wait_for(std::chrono::milliseconds(opt_.repl_timeout_ms)) !=
+        std::future_status::ready) {
+      out = Result::error(wire::ERR_TIMEOUT, "forwarded request timed out");
+    } else {
+      out = fut.get();
+    }
+    std::lock_guard<std::mutex> g(fwd_mu_);
+    fwd_pending_.erase(reqid);
+    return out;
+  }
+
+ private:
+  void handle_fwd_req(uint64_t reqid, const std::string& origin, uint8_t kind,
+                      const Bytes& payload) {
+    // leader_execute re-checks leadership itself and answers NOT_LEADER if
+    // the hint was stale — it never re-forwards, so hint chains cannot loop.
+    Result res = leader_execute(static_cast<FwdKind>(kind), payload);
+    Buf b;
+    b.u8(wire::P_FWD_RESP);
+    b.u64(reqid);
+    b.u8(res.ok ? 1 : 0);
+    if (res.ok) {
+      b.str(res.body);
+    } else {
+      b.u8(res.errkind);
+      b.str(res.body);
+    }
+    tr_->send(origin, b.s);
+  }
+
+  void handle_fwd_resp(Reader& r) {
+    uint64_t reqid = r.u64();
+    bool ok = r.u8() != 0;
+    Result res;
+    if (ok) {
+      res = Result::success(r.str());
+    } else {
+      uint8_t kind = r.u8();
+      res = Result::error(kind, r.str());
+    }
+    std::shared_ptr<std::promise<Result>> pend;
+    {
+      std::lock_guard<std::mutex> g(fwd_mu_);
+      auto it = fwd_pending_.find(reqid);
+      if (it == fwd_pending_.end()) return;  // timed out already
+      pend = it->second;
+      fwd_pending_.erase(it);
+    }
+    pend->set_value(std::move(res));
+  }
+
+  // ---- state -----------------------------------------------------------
+
+  Options opt_;
+  StateMachine* sm_;
+  Transport* tr_;
+  std::mt19937 rng_;
+
+  std::mutex mu_;
+  Role role_ = Role::Follower;
+  std::string leader_hint_;
+  std::vector<MemberSpec> config_;
+  RaftLog log_;
+  uint64_t commit_index_ = 0;
+  uint64_t last_applied_ = 0;
+  std::map<std::string, uint64_t> next_index_, match_index_;
+  std::set<std::string> votes_;
+  Clock::time_point election_deadline_{};
+  Clock::time_point next_heartbeat_{};
+  std::map<uint64_t, std::shared_ptr<Pending>> pending_;
+
+  std::mutex fwd_mu_;
+  uint64_t next_fwd_id_ = 1;
+  std::map<uint64_t, std::shared_ptr<std::promise<Result>>> fwd_pending_;
+
+  std::condition_variable apply_cv_;
+  std::atomic<bool> running_{false};
+  std::thread ticker_, applier_;
+};
+
+}  // namespace raftnative

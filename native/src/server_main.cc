@@ -1,0 +1,253 @@
+// raft_server — the SUT node daemon.
+//
+// Capability equivalent of the reference's server process: the Java
+// Server.java daemon (TCP request server + state-machine dispatch,
+// Server.java:128-158) plus its CLI wrapper
+// (server/src/jgroups/raft/server.clj:12-46: -m members, -n name,
+// -s state-machine, 30 s repl timeout). One listening port per node serves
+// both state-machine requests and node-local admin commands (leader probe,
+// membership add/remove, partition block/unblock) — the admin surface covers
+// what the reference reaches via JMX probe (server.clj:34-39) and the
+// jgroups-raft membership CLI (membership.clj:22-35).
+//
+// Request handling is synchronous per connection: each frame is
+// uuid | domain | body, answered with uuid | ok | payload-or-error, so a
+// client can correlate out-of-order responses if it ever pipelines
+// (SyncClient.java:62-69's uuid→future map remains implementable).
+
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <thread>
+
+#include "common.h"
+#include "log.h"
+#include "net.h"
+#include "raft.h"
+#include "sm.h"
+#include "wire.h"
+
+using namespace raftnative;
+
+namespace {
+
+struct Flags {
+  std::string name;
+  std::string members;
+  std::string sm = "map";
+  std::string log_dir;
+  int election_ms = 300;
+  int heartbeat_ms = 100;
+  int repl_timeout_ms = 30000;
+};
+
+Flags parse_flags(int argc, char** argv) {
+  Flags f;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", a.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--name" || a == "-n")
+      f.name = next();
+    else if (a == "--members" || a == "-m")
+      f.members = next();
+    else if (a == "--sm" || a == "-s")
+      f.sm = next();
+    else if (a == "--log-dir")
+      f.log_dir = next();
+    else if (a == "--election-ms")
+      f.election_ms = std::stoi(next());
+    else if (a == "--heartbeat-ms")
+      f.heartbeat_ms = std::stoi(next());
+    else if (a == "--repl-timeout-ms")
+      f.repl_timeout_ms = std::stoi(next());
+    else {
+      fprintf(stderr, "unknown flag: %s\n", a.c_str());
+      exit(2);
+    }
+  }
+  if (f.name.empty() || f.members.empty()) {
+    fprintf(stderr,
+            "usage: raft_server --name N --members a=h:cp:pp,... "
+            "[--sm map|counter|election] [--log-dir D] [--election-ms MS] "
+            "[--heartbeat-ms MS] [--repl-timeout-ms MS]\n");
+    exit(2);
+  }
+  return f;
+}
+
+void logline(const std::string& msg) {
+  fprintf(stdout, "[raft_server] %s\n", msg.c_str());
+  fflush(stdout);
+}
+
+Bytes error_response(const Bytes& uuid, uint8_t kind, const std::string& msg) {
+  Buf b;
+  b.raw(uuid);
+  b.u8(0);
+  b.u8(kind);
+  b.str(msg);
+  return b.s;
+}
+
+Bytes ok_response(const Bytes& uuid, const Bytes& body) {
+  Buf b;
+  b.raw(uuid);
+  b.u8(1);
+  b.raw(body);
+  return b.s;
+}
+
+Bytes handle_admin(RaftNode& raft, Transport& tr, const Bytes& uuid,
+                   Reader& r) {
+  uint8_t cmd = r.u8();
+  switch (cmd) {
+    case wire::ADM_PROBE: {
+      auto [leader, term] = raft.leader_info();
+      Buf b;
+      b.str(leader);
+      b.u64(term);
+      return ok_response(uuid, b.s);
+    }
+    case wire::ADM_ADD: {
+      MemberSpec m = MemberSpec::parse(r.str());
+      Result res = raft.add_server(m);
+      return res.ok ? ok_response(uuid, {})
+                    : error_response(uuid, res.errkind, res.body);
+    }
+    case wire::ADM_REMOVE: {
+      Result res = raft.remove_server(r.str());
+      return res.ok ? ok_response(uuid, {})
+                    : error_response(uuid, res.errkind, res.body);
+    }
+    case wire::ADM_BLOCK: {
+      std::set<std::string> peers;
+      std::stringstream ss(r.str());
+      std::string item;
+      while (std::getline(ss, item, ','))
+        if (!item.empty()) peers.insert(item);
+      tr.block(peers);
+      return ok_response(uuid, {});
+    }
+    case wire::ADM_UNBLOCK:
+      tr.unblock_all();
+      return ok_response(uuid, {});
+    case wire::ADM_MEMBERS: {
+      auto ms = raft.members();
+      Buf b;
+      b.u32(static_cast<uint32_t>(ms.size()));
+      for (const auto& m : ms) b.str(m.to_string());
+      return ok_response(uuid, b.s);
+    }
+    default:
+      return error_response(uuid, wire::ERR_SERVER, "bad admin command");
+  }
+}
+
+void client_conn(int cfd, RaftNode* raft, StateMachine* sm, Transport* tr) {
+  StateMachine::SubmitFn submit = [raft](const Bytes& op) {
+    return raft->submit(op);
+  };
+  try {
+    Bytes frame;
+    while (recv_frame(cfd, &frame)) {
+      if (frame.size() < static_cast<size_t>(wire::kUuidLen) + 1) break;
+      Bytes uuid = frame.substr(0, wire::kUuidLen);
+      Reader r(frame.data() + wire::kUuidLen,
+               frame.size() - wire::kUuidLen);
+      uint8_t domain = r.u8();
+      Bytes resp;
+      try {
+        if (domain == wire::DOMAIN_ADMIN) {
+          resp = handle_admin(*raft, *tr, uuid, r);
+        } else {
+          Result res = sm->receive(r.rest(), submit);
+          resp = res.ok ? ok_response(uuid, res.body)
+                        : error_response(uuid, res.errkind, res.body);
+        }
+      } catch (const std::exception& e) {
+        // Server-side exceptions cross the wire as failure responses and are
+        // re-raised client-side (Response.java:42-67 / SyncClient.java:97-99).
+        resp = error_response(uuid, wire::ERR_SERVER, e.what());
+      }
+      send_frame(cfd, resp);
+    }
+  } catch (const WireError&) {
+    // client went away mid-frame
+  }
+  ::close(cfd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);
+  Flags f = parse_flags(argc, argv);
+
+  std::vector<MemberSpec> members = parse_members(f.members);
+  MemberSpec self;
+  bool found = false;
+  for (const auto& m : members)
+    if (m.name == f.name) {
+      self = m;
+      found = true;
+    }
+  if (!found) {
+    fprintf(stderr, "node %s not in --members\n", f.name.c_str());
+    return 2;
+  }
+
+  MapStateMachine map_sm;
+  CounterStateMachine counter_sm;
+  ElectionStateMachine election_sm;
+  StateMachine* sm = nullptr;
+  if (f.sm == "map")
+    sm = &map_sm;
+  else if (f.sm == "counter")
+    sm = &counter_sm;
+  else if (f.sm == "election")
+    sm = &election_sm;
+  else {
+    fprintf(stderr, "unknown state machine: %s\n", f.sm.c_str());
+    return 2;
+  }
+
+  Transport tr;
+  RaftNode::Options opt;
+  opt.name = f.name;
+  opt.log_dir = f.log_dir;
+  opt.election_ms = f.election_ms;
+  opt.heartbeat_ms = f.heartbeat_ms;
+  opt.repl_timeout_ms = f.repl_timeout_ms;
+  opt.initial_members = members;
+  RaftNode raft(opt, sm, &tr);
+  election_sm.attach(&raft);
+
+  tr.start(f.name, "0.0.0.0", self.peer_port,
+           [&raft](const std::string& sender, uint8_t type, Reader& r) {
+             raft.on_peer_msg(sender, type, r);
+           });
+  raft.start();
+  logline("raft node " + f.name + " up; peers on :" +
+          std::to_string(self.peer_port));
+
+  // Client plane last: the harness treats "client port bound" as "node up"
+  // (reference server.clj:158-161 blocks on port 9000).
+  int lfd = listen_on("0.0.0.0", self.client_port);
+  logline("serving " + f.sm + " clients on :" +
+          std::to_string(self.client_port));
+  while (true) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(client_conn, cfd, &raft, sm, &tr).detach();
+  }
+}

@@ -1,0 +1,185 @@
+// The three pluggable state machines.
+//
+// Capability equivalents of the reference's Java state machines:
+//   MapStateMachine      ← ReplicatedMap.java (PUT/GET-with-quorum-flag/CAS;
+//                          the CAS opcode rides the replicated log and is
+//                          applied atomically on every replica, :30-53,96-106)
+//   CounterStateMachine  ← ReplicatedCounter.java (named counters;
+//                          GET/ADD/ADD_AND_GET/COMPARE_AND_SET, :25-58)
+//   ElectionStateMachine ← LeaderElection.java (NOT replicated — answers from
+//                          local raft metadata like an external observer,
+//                          :17-21,35-44; no-op apply/snapshot :47-55)
+//
+// Dirty vs quorum reads reproduce ReplicatedMap.java:65-75's contract: a
+// quorum read runs through consensus (a log round), a dirty read answers from
+// local applied state immediately.
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "raft.h"
+#include "wire.h"
+
+namespace raftnative {
+
+class MapStateMachine : public StateMachine {
+ public:
+  Bytes apply(const Bytes& op) override {
+    Reader r(op);
+    uint8_t cmd = r.u8();
+    std::lock_guard<std::mutex> g(mu_);
+    switch (cmd) {
+      case wire::MAP_PUT: {
+        uint64_t key = r.u64();
+        int64_t val = r.i64();
+        map_[key] = val;
+        return {};
+      }
+      case wire::MAP_GET: {
+        uint64_t key = r.u64();
+        return encode_get(key);
+      }
+      case wire::MAP_CAS: {
+        uint64_t key = r.u64();
+        int64_t from = r.i64();
+        int64_t to = r.i64();
+        auto it = map_.find(key);
+        bool success = (it != map_.end() && it->second == from);
+        if (success) it->second = to;
+        Buf b;
+        b.u8(success ? 1 : 0);
+        return b.s;
+      }
+      default:
+        throw WireError("map: bad opcode");
+    }
+  }
+
+  Result receive(const Bytes& body, const SubmitFn& submit) override {
+    Reader r(body);
+    uint8_t cmd = r.u8();
+    if (cmd == wire::MAP_GET) {
+      uint64_t key = r.u64();
+      bool quorum = r.u8() != 0;
+      if (!quorum) {
+        std::lock_guard<std::mutex> g(mu_);
+        return Result::success(encode_get(key));  // dirty read: local state
+      }
+      Buf op;  // quorum read: strip the flag, run the GET through the log
+      op.u8(wire::MAP_GET);
+      op.u64(key);
+      return submit(op.s);
+    }
+    return submit(body);  // PUT / CAS always replicate
+  }
+
+  void save(std::ostream& out) override {
+    std::lock_guard<std::mutex> g(mu_);
+    Buf b;
+    b.u32(static_cast<uint32_t>(map_.size()));
+    for (const auto& [k, v] : map_) {
+      b.u64(k);
+      b.i64(v);
+    }
+    out.write(b.s.data(), static_cast<std::streamsize>(b.s.size()));
+  }
+
+ private:
+  Bytes encode_get(uint64_t key) {
+    Buf b;
+    auto it = map_.find(key);
+    b.u8(it != map_.end() ? 1 : 0);
+    b.i64(it != map_.end() ? it->second : 0);
+    return b.s;
+  }
+
+  std::mutex mu_;
+  std::map<uint64_t, int64_t> map_;
+};
+
+class CounterStateMachine : public StateMachine {
+ public:
+  Bytes apply(const Bytes& op) override {
+    Reader r(op);
+    uint8_t cmd = r.u8();
+    std::string name = r.str();
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t& c = counters_[name];  // getOrCreateCounter(name, 0) analogue
+    Buf b;
+    switch (cmd) {
+      case wire::CTR_GET:
+        b.i64(c);
+        return b.s;
+      case wire::CTR_ADD:
+        // Options.create(true) analogue (ReplicatedCounter.java:35-41):
+        // replicate the add, return nothing.
+        c += r.i64();
+        return {};
+      case wire::CTR_ADD_AND_GET:
+        c += r.i64();
+        b.i64(c);
+        return b.s;
+      case wire::CTR_CAS: {
+        int64_t expect = r.i64();
+        int64_t update = r.i64();
+        bool success = (c == expect);
+        if (success) c = update;
+        b.u8(success ? 1 : 0);
+        return b.s;
+      }
+      default:
+        throw WireError("counter: bad opcode");
+    }
+  }
+
+  Result receive(const Bytes& body, const SubmitFn& submit) override {
+    Reader r(body);
+    uint8_t cmd = r.u8();
+    if (cmd == wire::CTR_GET) {
+      std::string name = r.str();
+      bool quorum = r.u8() != 0;
+      if (!quorum) {
+        std::lock_guard<std::mutex> g(mu_);
+        Buf b;
+        b.i64(counters_[name]);
+        return Result::success(b.s);
+      }
+      Buf op;
+      op.u8(wire::CTR_GET);
+      op.str(name);
+      return submit(op.s);
+    }
+    return submit(body);
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, int64_t> counters_;
+};
+
+class ElectionStateMachine : public StateMachine {
+ public:
+  // Needs the raft node for local metadata; wired post-construction because
+  // RaftNode also needs the state machine.
+  void attach(RaftNode* raft) { raft_ = raft; }
+
+  Bytes apply(const Bytes&) override { return {}; }  // nothing is replicated
+
+  Result receive(const Bytes& body, const SubmitFn&) override {
+    Reader r(body);
+    if (r.u8() != wire::ELE_INSPECT)
+      return Result::error(wire::ERR_SERVER, "election: bad opcode");
+    auto [leader, term] = raft_->leader_info();
+    Buf b;  // the [leader term] tuple (SyncLeaderInspectionClient.java:21-27)
+    b.str(leader);
+    b.u64(term);
+    return Result::success(b.s);
+  }
+
+ private:
+  RaftNode* raft_ = nullptr;
+};
+
+}  // namespace raftnative

@@ -1,0 +1,72 @@
+// Wire vocabulary shared by server, client library, and membership CLI.
+//
+// Client-facing protocol (capability equivalent of the reference's
+// Request/Response types — data/Request.java:11-45, data/Response.java:42-71 —
+// and the Command/RequestType dispatch bytes, Server.java:173-177,
+// ReplicatedCounter.java:60-65):
+//   request frame  = uuid(16 raw bytes) | domain u8 | body
+//   response frame = uuid(16) | ok u8 | (body  OR  errkind u8 | message str)
+// Errors cross the wire as (kind, message) rather than serialized Throwables;
+// the client maps kinds back onto the harness error taxonomy
+// (workload/client.clj:6-44).
+#pragma once
+
+#include <cstdint>
+
+namespace raftnative {
+namespace wire {
+
+// request domains
+constexpr uint8_t DOMAIN_SM = 0;     // state-machine op (replicated plane)
+constexpr uint8_t DOMAIN_ADMIN = 1;  // node-local admin / membership
+
+// state-machine commands: replicated map (Server.java Command enum analogue)
+constexpr uint8_t MAP_PUT = 1;
+constexpr uint8_t MAP_GET = 2;
+constexpr uint8_t MAP_CAS = 3;
+
+// state-machine commands: counter (ReplicatedCounter.RequestType analogue)
+constexpr uint8_t CTR_GET = 1;
+constexpr uint8_t CTR_ADD = 2;
+constexpr uint8_t CTR_ADD_AND_GET = 3;
+constexpr uint8_t CTR_CAS = 4;
+
+// state-machine commands: leader inspection (LeaderElection.java analogue)
+constexpr uint8_t ELE_INSPECT = 1;
+
+// admin commands. PROBE is the JMX leader-probe analogue (server.clj:34-39);
+// ADD/REMOVE are the membership CLI ops (membership.clj:22-35); BLOCK/UNBLOCK
+// are the transport-level partition hook standing in for iptables grudges —
+// same observable effect (no packets exchanged with blocked peers), injectable
+// on localhost clusters without root.
+constexpr uint8_t ADM_PROBE = 1;
+constexpr uint8_t ADM_ADD = 2;
+constexpr uint8_t ADM_REMOVE = 3;
+constexpr uint8_t ADM_BLOCK = 4;
+constexpr uint8_t ADM_UNBLOCK = 5;
+constexpr uint8_t ADM_MEMBERS = 6;  // current committed member list
+
+// response error kinds → harness taxonomy (client/errors.py)
+constexpr uint8_t ERR_NOT_LEADER = 1;  // definite (client.clj:34-44)
+constexpr uint8_t ERR_TIMEOUT = 2;     // indefinite: replication timed out
+constexpr uint8_t ERR_SERVER = 3;      // definite server-side rejection
+
+// peer-to-peer raft messages
+constexpr uint8_t P_HELLO = 1;      // str sender_name
+constexpr uint8_t P_VOTE_REQ = 2;   // term, candidate, last_idx, last_term
+constexpr uint8_t P_VOTE_RESP = 3;  // term, granted, voter
+constexpr uint8_t P_APP_REQ = 4;    // term, leader, prev_idx, prev_term,
+                                    // commit, n, entries[term,type,data]
+constexpr uint8_t P_APP_RESP = 5;   // term, success, follower, match/hint
+constexpr uint8_t P_FWD_REQ = 6;    // reqid, origin, sm body (REDIRECT analogue)
+constexpr uint8_t P_FWD_RESP = 7;   // reqid, ok, body-or-(errkind,msg)
+
+// raft log entry types
+constexpr uint8_t E_NOOP = 0;    // leader's term-opening no-op
+constexpr uint8_t E_OP = 1;      // state-machine op (body = sm payload)
+constexpr uint8_t E_CONFIG = 2;  // membership change (body = full new config)
+
+constexpr int kUuidLen = 16;
+
+}  // namespace wire
+}  // namespace raftnative
