@@ -1,0 +1,193 @@
+"""Command-line entry point: run tests, browse results.
+
+Equivalent of the reference's CLI layer (src/jepsen/jgroups/raft.clj:94-101
+wiring jepsen.cli/run! with single-test-cmd + serve-cmd):
+
+  python -m jepsen_jgroups_raft_tpu test  [flags]   — compose + run a test
+  python -m jepsen_jgroups_raft_tpu serve [flags]   — results web server
+
+Flags mirror the reference's cli-opts (raft.clj:14-51) plus the jepsen
+built-ins the docs exercise (--node/--nodes-file, --concurrency,
+--time-limit, --test-count; doc/running.md:88,152). The state machine is
+selected from the workload exactly like identify-state-machine
+(server.clj:103-109). Exit status is 0 iff every run's history verified
+(jepsen.cli behavior: a failed analysis fails the command).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core.compose import DEFAULTS, compose_test
+from .core.runner import run_test
+from .nemesis.package import FAULTS, SPECIALS
+from .workload import WORKLOADS
+
+# workload → native state machine (identify-state-machine, server.clj:103-109)
+WORKLOAD_SM = {
+    "single-register": "map",
+    "multi-register": "map",
+    "counter": "counter",
+    "election": "election",
+}
+
+
+def _add_test_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--workload", "-w", default=DEFAULTS["workload"],
+                   choices=sorted(WORKLOADS),
+                   help="workload name (raft.clj:29-33)")
+    p.add_argument("--nemesis", default=None,
+                   help="comma-separated faults %s or special %s "
+                        "(raft.clj:35-39, nemesis.clj:8-29)"
+                        % (sorted(FAULTS), sorted(SPECIALS)))
+    p.add_argument("--rate", type=float, default=DEFAULTS["rate"],
+                   help="approximate ops/sec (raft.clj:19-22)")
+    p.add_argument("--ops-per-key", type=int, default=DEFAULTS["ops_per_key"],
+                   help="op cap per key (raft.clj:24-27)")
+    p.add_argument("--interval", type=float, default=DEFAULTS["interval"],
+                   help="seconds between nemesis ops (raft.clj:41-44)")
+    p.add_argument("--operation-timeout", type=float,
+                   default=DEFAULTS["operation_timeout"],
+                   help="client op timeout, seconds (raft.clj:48-51)")
+    p.add_argument("--stale-reads", action="store_true",
+                   help="allow dirty local reads (raft.clj:14-17; "
+                        "quorum_reads = not stale_reads, raft.clj:92)")
+    p.add_argument("--time-limit", type=float, default=DEFAULTS["time_limit"],
+                   help="main-phase duration, seconds")
+    p.add_argument("--quiesce", type=float, default=DEFAULTS["quiesce"],
+                   help="post-phase quiet period, seconds (raft.clj:86-90's "
+                        "sleep 10)")
+    p.add_argument("--concurrency", type=int, default=DEFAULTS["concurrency"],
+                   help="client worker count")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="number of runs")
+    p.add_argument("--node", action="append", default=None,
+                   help="node name (repeatable)")
+    p.add_argument("--nodes-file", default=None,
+                   help="file with one node name per line")
+    p.add_argument("--store", default="store",
+                   help="results directory root")
+    p.add_argument("--algorithm", default="auto",
+                   choices=["auto", "jax", "cpu"],
+                   help="linearizability engine (:algorithm :jax analogue)")
+    p.add_argument("--deploy", default="local",
+                   choices=["local", "inmemory", "ssh"],
+                   help="SUT deployment tier: local native processes, "
+                        "in-process fake, or ssh remote hosts")
+    p.add_argument("--ssh-user", default="root")
+    p.add_argument("--ssh-private-key", default=None,
+                   help="identity file for the ssh tier (running.md:88)")
+    p.add_argument("--election-ms", type=int, default=300)
+    p.add_argument("--heartbeat-ms", type=int, default=100)
+    p.add_argument("--repl-timeout-ms", type=int, default=30000,
+                   help="server-side replication timeout "
+                        "(server/src/jgroups/raft/server.clj:37)")
+
+
+def _nodes_from(args) -> list:
+    if args.node:
+        return list(args.node)
+    if args.nodes_file:
+        lines = Path(args.nodes_file).read_text().splitlines()
+        return [ln.strip() for ln in lines if ln.strip()]
+    return [f"n{i}" for i in range(1, 6)]
+
+
+def _build_deployment(args, nodes):
+    """Returns (db, net, conn_factory, shutdown_fn)."""
+    sm = WORKLOAD_SM[args.workload]
+    if args.deploy == "inmemory":
+        from .core.db import InMemoryDB, InMemoryNet
+        from .sut.inmemory import InMemoryCluster, LatencyPlan
+        cluster = InMemoryCluster(nodes, LatencyPlan())
+        return (InMemoryDB(cluster), InMemoryNet(cluster), cluster.conn,
+                cluster.shutdown)
+    if args.deploy == "ssh":
+        from .deploy.ssh import RemoteRaftCluster, RemoteRaftDB, IptablesNet
+        cluster = RemoteRaftCluster(
+            nodes, sm=sm, ssh_user=args.ssh_user,
+            ssh_key=args.ssh_private_key,
+            election_ms=args.election_ms, heartbeat_ms=args.heartbeat_ms,
+            repl_timeout_ms=args.repl_timeout_ms)
+        return (RemoteRaftDB(cluster), IptablesNet(cluster),
+                cluster.conn_factory(), cluster.shutdown)
+    from .deploy.local import BlockNet, LocalCluster, LocalRaftDB
+    cluster = LocalCluster(
+        nodes, sm=sm, election_ms=args.election_ms,
+        heartbeat_ms=args.heartbeat_ms,
+        repl_timeout_ms=args.repl_timeout_ms)
+    return (LocalRaftDB(cluster), BlockNet(cluster), cluster.conn_factory(),
+            cluster.shutdown)
+
+
+def cmd_test(args) -> int:
+    nodes = _nodes_from(args)
+    ok = True
+    for i in range(args.test_count):
+        db, net, conn_factory, shutdown = _build_deployment(args, nodes)
+        opts = {
+            "nodes": nodes,
+            "workload": args.workload,
+            "nemesis": args.nemesis,
+            "rate": args.rate,
+            "ops_per_key": args.ops_per_key,
+            "interval": args.interval,
+            "operation_timeout": args.operation_timeout,
+            "stale_reads": args.stale_reads,
+            "time_limit": args.time_limit,
+            "quiesce": args.quiesce,
+            "concurrency": args.concurrency,
+            "conn_factory": conn_factory,
+            "store_root": args.store,
+            "algorithm": args.algorithm,
+        }
+        test = compose_test(opts, db=db, net=net)
+        try:
+            test = run_test(test)
+        finally:
+            shutdown()
+        res = test["results"]
+        # Strict: "unknown" (checker budget exceeded / checker crashed) is
+        # NOT a pass — jepsen's CLI likewise fails the command on any
+        # non-true analysis.
+        verdict = res.get("valid?")
+        valid = verdict is True
+        ok = ok and valid
+        label = {True: "VALID", False: "INVALID"}.get(verdict,
+                                                      f"UNKNOWN ({verdict})")
+        print(f"run {i + 1}/{args.test_count}: {label}  "
+              f"store={test.get('store_dir')}")
+        if not valid:
+            print(json.dumps(res, indent=2, default=str)[:4000])
+    # Everything looks good! ヽ('ー`)ノ — or not.
+    print("Everything looks good!" if ok else "Analysis invalid! (ノಥ益ಥ)ノ")
+    return 0 if ok else 1
+
+
+def cmd_serve(args) -> int:
+    from .core.serve import serve
+    return serve(args.store, host=args.host, port=args.port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jepsen_jgroups_raft_tpu",
+        description="TPU-native distributed-systems test harness")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("test", help="run a test (single-test-cmd analogue)")
+    _add_test_flags(t)
+    t.set_defaults(fn=cmd_test)
+    s = sub.add_parser("serve", help="results web server (serve-cmd)")
+    s.add_argument("--store", default="store")
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=8080)
+    s.set_defaults(fn=cmd_serve)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,6 +244,11 @@ def run_test(test: dict) -> dict:
     for t in threads:
         t.join()
 
+    # Prepare the run directory BEFORE log collection so DBs that download
+    # node logs (ssh tier) can place them inside this run's store dir.
+    if test.get("store", True) and "store_dir" not in test:
+        test["store_dir"] = prepare_dir(test)
+
     if db is not None:
         logs = {}
         if hasattr(db, "log_files"):
@@ -257,8 +262,6 @@ def run_test(test: dict) -> dict:
             list(ex.map(lambda n: db.teardown(test, n), test["nodes"]))
 
     test["history"] = history
-    if test.get("store", True) and "store_dir" not in test:
-        test["store_dir"] = prepare_dir(test)
     checker = test.get("checker")
     if checker is not None:
         LOG.info("checking %d-op history", len(history))

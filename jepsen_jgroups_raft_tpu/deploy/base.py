@@ -1,0 +1,89 @@
+"""Shared DB-protocol implementation over a raft cluster object.
+
+Both deployment tiers (local processes, ssh remote hosts) expose the same
+cluster contract — start_node/kill_node/pause_node/resume_node, probe,
+admin, spec — so the jepsen.db protocol family (reference
+server.clj:164-222) is implemented once here and parameterized by the
+cluster. Tier subclasses override only what genuinely differs: readiness
+waits, teardown cleanup, and log collection.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from ..core.db import DB
+
+
+class RaftDB(DB):
+    def __init__(self, cluster, seed: Optional[int] = None):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+
+    def _members(self, test) -> List[str]:
+        ms = test.get("members")
+        return sorted(ms) if ms else list(test["nodes"])
+
+    def _alive(self, node: str) -> bool:
+        """Is the node worth routing an admin op through? Overridden per
+        tier (process liveness locally; probe reachability remotely)."""
+        return self.cluster.probe(node) is not None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def setup(self, test, node):
+        self.cluster.start_node(node, set(self._members(test)) | {node})
+
+    def kill(self, test, node):
+        self.cluster.kill_node(node)
+
+    def start(self, test, node):
+        self.cluster.start_node(node, set(self._members(test)) | {node})
+
+    def pause(self, test, node):
+        self.cluster.pause_node(node)
+
+    def resume(self, test, node):
+        self.cluster.resume_node(node)
+
+    # ---- Primary ---------------------------------------------------------
+
+    def primaries(self, test):
+        """Every member's local leader view, deduped non-null — may
+        legitimately return 2+ during partitions (server.clj:188-196)."""
+        views = []
+        for n in self._members(test):
+            view = self.cluster.probe(n)
+            if view is not None and view[0] and view[0] not in views:
+                views.append(view[0])
+        return views
+
+    # ---- membership via consensus through an alive member ---------------
+    # (the CLI-over-SSH path, membership.clj:22-35; kill-before-remove and
+    # majority guards live in the nemesis)
+
+    def _via(self, test, exclude=()) -> Optional[str]:
+        candidates = [n for n in self._members(test)
+                      if n not in exclude and self._alive(n)]
+        return self.rng.choice(candidates) if candidates else None
+
+    def add_member(self, test, node):
+        via = self._via(test, exclude={node})
+        if via is None:
+            raise RuntimeError("no alive member to run add through")
+        conn = self.cluster.admin(via, timeout=15.0)
+        try:
+            conn.admin_add(self.cluster.spec(node))
+        finally:
+            conn.close()
+
+    def remove_member(self, test, node):
+        via = self._via(test, exclude={node})
+        if via is None:
+            raise RuntimeError("no alive member to run remove through")
+        conn = self.cluster.admin(via, timeout=15.0)
+        try:
+            conn.admin_remove(node)
+        finally:
+            conn.close()

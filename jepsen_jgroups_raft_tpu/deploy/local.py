@@ -31,9 +31,10 @@ import time
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from ..core.db import DB, Net
+from ..core.db import Net
 from ..native import SERVER_BIN, ensure_built
 from ..native.client import NativeConn, make_conn_factory
+from .base import RaftDB
 
 
 def _free_port() -> int:
@@ -181,19 +182,11 @@ class LocalCluster:
         return make_conn_factory(self.resolve)
 
 
-class LocalRaftDB(DB):
+class LocalRaftDB(RaftDB):
     """DB/Kill/Pause/Primary/LogFiles protocols over a LocalCluster."""
 
-    def __init__(self, cluster: LocalCluster, seed: Optional[int] = None):
-        self.cluster = cluster
-        self.rng = random.Random(seed)
-
-    def _members(self, test) -> List[str]:
-        ms = test.get("members")
-        return sorted(ms) if ms else list(test["nodes"])
-
-    def setup(self, test, node):
-        self.cluster.start_node(node, set(self._members(test)) | {node})
+    def _alive(self, node):
+        return self.cluster.running(node)
 
     def teardown(self, test, node):
         self.cluster.kill_node(node)
@@ -207,54 +200,6 @@ class LocalRaftDB(DB):
     def log_files(self, test, node):
         p = self.cluster.log_path(node)
         return [str(p)] if p.exists() else []
-
-    def primaries(self, test):
-        views = []
-        for n in self._members(test):
-            view = self.cluster.probe(n)
-            if view is not None and view[0] and view[0] not in views:
-                views.append(view[0])
-        return views
-
-    def kill(self, test, node):
-        self.cluster.kill_node(node)
-
-    def start(self, test, node):
-        self.cluster.start_node(node, set(self._members(test)) | {node})
-
-    def pause(self, test, node):
-        self.cluster.pause_node(node)
-
-    def resume(self, test, node):
-        self.cluster.resume_node(node)
-
-    # membership via consensus through an alive member (membership.clj's
-    # CLI-over-SSH path, :22-35; the nemesis does kill-before-remove and
-    # majority guards itself)
-    def _via(self, test, exclude=()) -> Optional[str]:
-        candidates = [n for n in self._members(test)
-                      if n not in exclude and self.cluster.running(n)]
-        return self.rng.choice(candidates) if candidates else None
-
-    def add_member(self, test, node):
-        via = self._via(test, exclude={node})
-        if via is None:
-            raise RuntimeError("no alive member to run add through")
-        conn = self.cluster.admin(via, timeout=15.0)
-        try:
-            conn.admin_add(self.cluster.spec(node))
-        finally:
-            conn.close()
-
-    def remove_member(self, test, node):
-        via = self._via(test, exclude={node})
-        if via is None:
-            raise RuntimeError("no alive member to run remove through")
-        conn = self.cluster.admin(via, timeout=15.0)
-        try:
-            conn.admin_remove(node)
-        finally:
-            conn.close()
 
 
 class BlockNet(Net):

@@ -1,0 +1,60 @@
+"""CLI layer: the `test` and `serve` commands (reference raft.clj:94-101)."""
+
+import json
+import os
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.cli import main
+from jepsen_jgroups_raft_tpu.core.serve import _index_html, _run_dirs
+
+
+def test_cli_test_command_local_native(tmp_path):
+    """Full CLI run over the local native deployment: exit 0 and a
+    populated store dir."""
+    store = tmp_path / "store"
+    rc = main([
+        "test", "--workload", "single-register", "--deploy", "local",
+        "--node", "n1", "--node", "n2", "--node", "n3",
+        "--time-limit", "3", "--quiesce", "0.5", "--rate", "20",
+        "--concurrency", "4", "--operation-timeout", "3",
+        "--election-ms", "150", "--heartbeat-ms", "50",
+        "--repl-timeout-ms", "3000",
+        "--store", str(store),
+    ])
+    assert rc == 0
+    runs = _run_dirs(store)
+    assert len(runs) == 1
+    with open(runs[0] / "results.json") as f:
+        assert json.load(f)["valid?"] is True
+
+
+def test_cli_test_command_inmemory_with_nemesis(tmp_path):
+    store = tmp_path / "store"
+    rc = main([
+        "test", "--workload", "counter", "--deploy", "inmemory",
+        "--nemesis", "partition",
+        "--time-limit", "3", "--quiesce", "0.3", "--rate", "30",
+        "--interval", "1", "--concurrency", "4",
+        "--operation-timeout", "1", "--store", str(store),
+    ])
+    assert rc == 0
+
+
+def test_cli_rejects_unknown_workload():
+    with pytest.raises(SystemExit):
+        main(["test", "--workload", "nope"])
+
+
+def test_serve_index_lists_runs(tmp_path):
+    run = tmp_path / "store" / "t" / "20260729T000000"
+    run.mkdir(parents=True)
+    (run / "results.json").write_text(json.dumps({"valid?": True}))
+    (run / "history.jsonl").write_text("")
+    bad = tmp_path / "store" / "t" / "20260729T000001"
+    bad.mkdir(parents=True)
+    (bad / "results.json").write_text(json.dumps({"valid?": False}))
+    page = _index_html(tmp_path / "store")
+    assert "20260729T000000" in page and "valid" in page
+    assert "INVALID" in page  # the failing run is flagged
+    assert "history.jsonl" in page

@@ -1,0 +1,59 @@
+"""SSH deployment tier: the pure command builders (the executable logic of
+the jepsen.control analogue, testable without remote hosts)."""
+
+from jepsen_jgroups_raft_tpu.deploy.ssh import (
+    CHAIN,
+    REMOTE_BIN,
+    REMOTE_PID,
+    SshRemote,
+    iptables_heal_cmds,
+    iptables_partition_cmds,
+    iptables_setup_cmds,
+    kill_cmd,
+    pause_cmd,
+    resume_cmd,
+    start_daemon_cmd,
+)
+
+
+def test_start_daemon_cmd_is_idempotent_and_daemonized():
+    cmd = start_daemon_cmd("n1", "n1=n1:9000:9100,n2=n2:9000:9100", "map",
+                           300, 100, 30000)
+    # idempotence gate (server.clj:143-146) and daemonization pieces
+    assert "kill -0 $(cat " + REMOTE_PID + ")" in cmd
+    assert "already-running" in cmd
+    assert "nohup" in cmd and REMOTE_BIN in cmd
+    assert "--sm map" in cmd
+    assert "echo $! > " + REMOTE_PID in cmd
+
+
+def test_kill_cmd_loops_until_dead():
+    cmd = kill_cmd()
+    assert "kill -9" in cmd and "seq 1 50" in cmd  # definitely-stop! loop
+    assert "rm -f " + REMOTE_PID in cmd
+
+
+def test_pause_resume_use_stop_cont():
+    assert "-STOP" in pause_cmd()
+    assert "-CONT" in resume_cmd()
+
+
+def test_iptables_partition_rules():
+    cmds = iptables_partition_cmds({"n2", "n3"})
+    assert len(cmds) == 2
+    assert all(CHAIN in c and "-j DROP" in c for c in cmds)
+    assert any("-s n2" in c for c in cmds)
+    # heal flushes only the dedicated chain, never other rules
+    heal = iptables_heal_cmds()
+    assert heal == [f"iptables -F {CHAIN} 2>/dev/null || true"]
+    setup = iptables_setup_cmds()
+    assert any("-N " + CHAIN in c for c in setup)
+
+
+def test_ssh_remote_command_shape():
+    r = SshRemote("host1", user="admin", key="/k/id")
+    base = r._ssh_base()
+    assert base[0] == "ssh"
+    assert "admin@host1" == base[-1]
+    assert "-i" in base and "/k/id" in base
+    assert any("ConnectTimeout" in b for b in base)
