@@ -73,6 +73,11 @@ def _add_test_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--algorithm", default="auto",
                    choices=["auto", "jax", "cpu"],
                    help="linearizability engine (:algorithm :jax analogue)")
+    p.add_argument("--platform", default=None,
+                   choices=["cpu", "tpu"],
+                   help="pin the JAX backend for checking (e.g. cpu when "
+                        "no accelerator is reachable); default: JAX's "
+                        "platform autodetection")
     p.add_argument("--deploy", default="local",
                    choices=["local", "inmemory", "ssh"],
                    help="SUT deployment tier: local native processes, "
@@ -124,6 +129,11 @@ def _build_deployment(args, nodes):
 
 
 def cmd_test(args) -> int:
+    if args.platform:
+        # Must land before the first backend initialization (the checker's
+        # first device use); config update after `import jax` is fine.
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     nodes = _nodes_from(args)
     ok = True
     for i in range(args.test_count):

@@ -96,7 +96,7 @@ def compose_test(opts: dict, db=None, net=None,
     gen = Phases(*phases)
 
     checker = compose_checkers({
-        "perf": PerfChecker(render=o.get("render_plots", False),
+        "perf": PerfChecker(render=o.get("render_plots", True),
                             nemeses=pkg.perf),
         "exceptions": UnhandledExceptionsChecker(),
         "stats": StatsChecker(),

@@ -66,8 +66,9 @@ class LocalCluster:
     def __init__(self, names: Iterable[str], sm: str = "map",
                  workdir: Optional[str] = None, election_ms: int = 150,
                  heartbeat_ms: int = 50, repl_timeout_ms: int = 10000,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", server_bin: Optional[str] = None):
         ensure_built()
+        self.server_bin = str(server_bin or SERVER_BIN)
         self.host = host
         self.sm = sm
         self.election_ms = election_ms
@@ -115,7 +116,7 @@ class LocalCluster:
         members_arg = ",".join(self.spec(n) for n in names)
         log = open(self.log_path(name), "ab")
         self.procs[name] = subprocess.Popen(
-            [str(SERVER_BIN), "--name", name, "--members", members_arg,
+            [self.server_bin, "--name", name, "--members", members_arg,
              "--sm", self.sm, "--log-dir", str(self.workdir / "raftlog"),
              "--election-ms", str(self.election_ms),
              "--heartbeat-ms", str(self.heartbeat_ms),

@@ -38,10 +38,24 @@ def _sources_mtime() -> float:
 def ensure_built(san: str = "") -> None:
     """Build the native tier if binaries are missing or stale. Idempotent
     and serialized (build once per process, like build-server!'s
-    primary-gated single build)."""
+    primary-gated single build). Sanitizer builds (`san="tsan"|"asan"`)
+    land in native/build-<san>/ without disturbing the normal binaries."""
     global _built
     with _build_lock:
         if _built and not san:
+            return
+        if san:
+            build_dir = NATIVE_DIR / f"build-{san}"
+            server = build_dir / "raft_server"
+            stale = (not server.exists()
+                     or _sources_mtime() > server.stat().st_mtime)
+            if stale:
+                proc = subprocess.run(
+                    ["make", "-C", str(NATIVE_DIR), f"SAN={san}"],
+                    capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise RuntimeError(f"native {san} build failed:\n"
+                                       f"{proc.stdout}\n{proc.stderr}")
             return
         stale = not (SERVER_BIN.exists() and CLIENT_LIB.exists()
                      and MEMBER_CLI.exists())
@@ -49,15 +63,10 @@ def ensure_built(san: str = "") -> None:
             stale = _sources_mtime() > min(
                 SERVER_BIN.stat().st_mtime, CLIENT_LIB.stat().st_mtime,
                 MEMBER_CLI.stat().st_mtime)
-        if stale or san:
-            env = dict(os.environ)
-            cmd = ["make", "-C", str(NATIVE_DIR)]
-            if san:
-                cmd = ["make", "-C", str(NATIVE_DIR), f"SAN={san}"]
-                subprocess.run(["make", "-C", str(NATIVE_DIR), "clean"],
-                               check=True, capture_output=True)
-            proc = subprocess.run(cmd, env=env, capture_output=True,
-                                  text=True)
+        if stale:
+            proc = subprocess.run(["make", "-C", str(NATIVE_DIR)],
+                                  env=dict(os.environ),
+                                  capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
                     f"native build failed:\n{proc.stdout}\n{proc.stderr}")

@@ -35,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..history.packing import EV_FORCE, EV_OPEN
@@ -49,7 +50,11 @@ MAX_SLOTS = 31
 
 DEFAULT_N_CONFIGS = 256
 
-_SENT = jnp.uint32(0xFFFFFFFF)  # empty-frontier-entry sentinel mask
+# Empty-frontier-entry sentinel mask. A NumPy (not jnp) scalar on purpose:
+# a module-level jnp constant would initialize the JAX backend at import
+# time, hanging importers when the accelerator is unreachable and
+# defeating late platform pinning (cli --platform).
+_SENT = np.uint32(0xFFFFFFFF)
 
 
 def _dedup_compact(masks, states, n_configs):

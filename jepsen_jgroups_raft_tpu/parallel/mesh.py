@@ -89,31 +89,69 @@ def sharded_batch_checker(model, mesh: Mesh,
     return fn
 
 
-def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
-                        n_configs: int = DEFAULT_N_CONFIGS,
-                        n_slots: int = MAX_SLOTS):
-    """Check a packed event batch across the mesh.
+def _bucket(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
 
-    events: [B, E, 5] int32 (history/packing.py layout). Pads B up to a
-    multiple of the mesh size with EV_PAD histories (trivially valid, no
-    FORCE events → sliced off afterwards). Returns (ok[B], overflow[B],
-    n_valid, n_unknown) as host values, with the aggregates corrected for
-    padding.
-    """
-    mesh = mesh or make_mesh()
+
+def _run_once(model, events: np.ndarray, mesh: Mesh, n_configs: int,
+              n_slots: int):
+    """One sharded launch at a fixed frontier capacity, with mesh-size
+    padding handled. B is bucketed to a power of two so escalation rungs
+    (whose subset sizes vary run to run) hit the jit cache instead of
+    recompiling per call."""
     axis_name = mesh.axis_names[0]
     n = mesh.devices.size
     B = events.shape[0]
-    Bp = ((B + n - 1) // n) * n
+    Bp = _bucket(B, 8)               # few distinct compile shapes
+    Bp = ((Bp + n - 1) // n) * n     # divisible by the mesh size
     if Bp != B:
         pad = np.zeros((Bp - B,) + events.shape[1:], dtype=events.dtype)
         events = np.concatenate([events, pad], axis=0)
     sharding = NamedSharding(mesh, P(axis_name, None, None))
     dev_events = jax.device_put(events, sharding)
     fn = sharded_batch_checker(model, mesh, n_configs, n_slots, axis_name)
-    ok, overflow, n_valid, n_unknown = fn(dev_events)
-    ok = np.asarray(ok)[:B]
-    overflow = np.asarray(overflow)[:B]
-    # Pad histories verify trivially valid; subtract them from the count.
-    n_valid = int(n_valid) - (Bp - B)
-    return ok, overflow, n_valid, int(n_unknown)
+    ok, overflow, _, _ = fn(dev_events)
+    return np.asarray(ok)[:B], np.asarray(overflow)[:B]
+
+
+def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
+                        n_configs: Optional[int] = None,
+                        n_slots: int = MAX_SLOTS):
+    """Check a packed event batch across the mesh.
+
+    events: [B, E, 5] int32 (history/packing.py layout). Pads B up to a
+    multiple of the mesh size with EV_PAD histories (trivially valid, no
+    FORCE events → sliced off afterwards). Returns (ok[B], overflow[B],
+    n_valid, n_unknown) host values corrected for padding.
+
+    Capacity ladder (unless `n_configs` pins one rung): kernel cost is
+    linear in the frontier capacity and "valid" at small capacity is final
+    (overflow can only lose configurations — false-INVALID, never
+    false-VALID), so the whole batch runs at C=64 and only the overflowed
+    minority re-runs at full capacity.
+    """
+    mesh = mesh or make_mesh()
+    ladder = ([n_configs] if n_configs else
+              [64, DEFAULT_N_CONFIGS] if DEFAULT_N_CONFIGS > 64
+              else [DEFAULT_N_CONFIGS])
+    B = events.shape[0]
+    ok = np.zeros((B,), dtype=bool)
+    overflow = np.zeros((B,), dtype=bool)
+    remaining = np.arange(B)
+    for rung, C in enumerate(ladder):
+        r_ok, r_ovf = _run_once(model, events[remaining], mesh, C, n_slots)
+        ok[remaining] = r_ok
+        overflow[remaining] = r_ovf
+        # escalate only undecided rows: overflowed AND not proven valid
+        escalate = remaining[r_ovf & ~r_ok]
+        if rung + 1 >= len(ladder) or escalate.size == 0:
+            break
+        remaining = escalate
+    # ok counts as valid even when the frontier overflowed: the witnessed
+    # linearization is real. Only overflowed-and-not-ok is undecided.
+    n_valid = int(np.sum(ok))
+    n_unknown = int(np.sum(overflow & ~ok))
+    return ok, overflow, n_valid, n_unknown

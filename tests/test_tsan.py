@@ -1,0 +1,85 @@
+"""Run the native server under ThreadSanitizer and assert zero reports.
+
+SURVEY.md §5.2: the reference leans on JVM memory safety; this build's C++
+tier gets the sanitizer treatment instead. The cluster runs a concurrent
+op mix with a leader kill and a partition (the thread-interaction hot
+paths: ticker vs transport readers vs apply loop vs client conns), then
+every node log is scanned for TSAN warnings.
+
+Set SKIP_TSAN=1 to skip (e.g. on machines without sanitizer runtimes).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.deploy.local import BlockNet, LocalCluster
+from jepsen_jgroups_raft_tpu.native import NATIVE_DIR, ensure_built
+from jepsen_jgroups_raft_tpu.native.client import NativeRsmConn
+
+NODES = ["n1", "n2", "n3"]
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_TSAN") == "1",
+                    reason="SKIP_TSAN=1")
+def test_native_server_is_race_clean_under_tsan(tmp_path):
+    ensure_built(san="tsan")
+    cluster = LocalCluster(
+        NODES, sm="map", workdir=str(tmp_path / "sut"),
+        election_ms=300, heartbeat_ms=100, repl_timeout_ms=5000,
+        server_bin=str(NATIVE_DIR / "build-tsan" / "raft_server"))
+    try:
+        for n in NODES:
+            cluster.start_node(n, NODES, wait=False)
+        from jepsen_jgroups_raft_tpu.deploy.local import wait_for_port
+        for n in NODES:
+            wait_for_port(*cluster.resolve(n), timeout=30.0)
+
+        stop = time.monotonic() + 6.0
+
+        def worker(node, k):
+            conn = NativeRsmConn(*cluster.resolve(node), timeout=2.0)
+            try:
+                i = 0
+                while time.monotonic() < stop:
+                    i += 1
+                    try:
+                        conn.put(k, i)
+                        conn.get(k, quorum=(i % 2 == 0))
+                        conn.cas(k, i, i + 1)
+                    except Exception:
+                        time.sleep(0.05)  # elections/faults in progress
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker, args=(n, k))
+                   for k, n in enumerate(NODES * 2)]
+        for t in threads:
+            t.start()
+        # poke the thread-interaction paths while ops fly
+        time.sleep(1.0)
+        net = BlockNet(cluster)
+        test = {"nodes": NODES, "members": set(NODES)}
+        net.partition(test, {"n1": {"n2", "n3"}, "n2": {"n1"},
+                             "n3": {"n1"}})
+        time.sleep(1.0)
+        net.heal(test)
+        time.sleep(0.5)
+        cluster.kill_node("n2")
+        time.sleep(1.0)
+        cluster.start_node("n2", NODES)
+        for t in threads:
+            t.join()
+    finally:
+        cluster.shutdown()
+
+    reports = []
+    for n in NODES:
+        text = cluster.log_path(n).read_text(errors="replace")
+        if "WARNING: ThreadSanitizer" in text:
+            # keep just the headline lines for the assertion message
+            reports += [ln for ln in text.splitlines()
+                        if "WARNING: ThreadSanitizer" in ln][:5]
+    assert not reports, f"TSAN reports in server logs: {reports}"
