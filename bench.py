@@ -9,24 +9,67 @@ north star.
 Prints ONE JSON line:
   {"metric": "histories_per_sec", "value": N, "unit": "hist/s",
    "vs_baseline": N, ...}
+and on ANY failure still prints one JSON line with value 0.0 and an
+"error" field (round-1 lesson: a raw traceback is not a diagnosable
+artifact).
+
+Platform selection: the TPU backend ('axon' via a tunnel) can block
+forever during init when the tunnel is down, so the default backend is
+probed in a SUBPROCESS with a timeout first; on probe failure the main
+process pins jax to CPU (loudly, in the JSON) and still records a number.
 
 Timing covers pack + device transfer + kernel (one warm-up launch first to
 exclude XLA compilation, which is cached across runs of the same shapes).
-History synthesis is excluded: it stands in for the test run that normally
-produces the history.
+`pack_time_s` / `kernel_time_s` split host packing from the device check
+so the dominating side is visible. History synthesis is excluded: it
+stands in for the test run that normally produces the history.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
+import subprocess
 import sys
 import time
+import traceback
+
+PROBE_TIMEOUT_S = 120.0  # first TPU init can be slow; hang is the failure mode
 
 
-def main() -> None:
-    import numpy as np  # noqa: F401
+def probe_default_platform() -> str | None:
+    """Return the default jax platform, probed in a subprocess so a hung
+    backend init (unreachable TPU tunnel) cannot hang the benchmark."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=PROBE_TIMEOUT_S, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    return platform or None
 
+
+from jepsen_jgroups_raft_tpu.platform import pin_cpu  # noqa: E402
+
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def fail(msg: str, **extra) -> None:
+    emit({"metric": "histories_per_sec", "value": 0.0, "unit": "hist/s",
+          "vs_baseline": 0.0, "error": msg, **extra})
+
+
+def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     import jax
 
     from jepsen_jgroups_raft_tpu.history.packing import encode_history, pack_batch
@@ -37,10 +80,7 @@ def main() -> None:
 
     maybe_init_distributed()
 
-    n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
-    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
     n_procs = 5
-
     rng = random.Random(20260729)
     model = CasRegister()
     histories = [
@@ -56,28 +96,25 @@ def main() -> None:
     def run():
         t0 = time.perf_counter()
         batch = pack_batch(encs)
+        t1 = time.perf_counter()
         ok, overflow, n_valid, n_unknown = check_batch_sharded(
             model, batch["events"], mesh, n_configs=128, n_slots=n_slots
         )
-        dt = time.perf_counter() - t0
-        return dt, n_valid, n_unknown
+        t2 = time.perf_counter()
+        return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
 
     run()  # warm-up: compile
-    dt, n_valid, n_unknown = run()
+    dt, dt_pack, dt_kernel, n_valid, n_unknown = run()
 
     if n_valid + n_unknown != n_histories or n_unknown > 0:
         # Soundness check: every synthetic history is valid by construction.
-        print(json.dumps({
-            "metric": "histories_per_sec", "value": 0.0, "unit": "hist/s",
-            "vs_baseline": 0.0,
-            "error": f"verdict mismatch: valid={n_valid} "
-                     f"unknown={n_unknown} of {n_histories}",
-        }))
+        fail(f"verdict mismatch: valid={n_valid} unknown={n_unknown} "
+             f"of {n_histories}", platform=platform_note)
         return
 
     rate = n_histories / dt
     baseline_rate = 1000.0 / 60.0  # north-star target (BASELINE.md)
-    print(json.dumps({
+    emit({
         "metric": "histories_per_sec",
         "value": round(rate, 2),
         "unit": "hist/s",
@@ -87,10 +124,49 @@ def main() -> None:
         "n_procs": n_procs,
         "concurrency_window": n_slots,
         "time_s": round(dt, 3),
+        "pack_time_s": round(dt_pack, 3),
+        "kernel_time_s": round(dt_kernel, 3),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
-    }))
+        "platform_note": platform_note,
+    })
+
+
+def main() -> None:
+    n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+
+    if os.environ.get("JGRAFT_BENCH_PLATFORM"):  # explicit override
+        platform = os.environ["JGRAFT_BENCH_PLATFORM"]
+        if platform == "cpu":
+            pin_cpu()
+        note = f"forced:{platform}"
+    elif os.environ.get("JAX_PLATFORMS"):
+        # Platform already pinned by the environment: no probe needed (the
+        # probe exists only to detect a hung default-TPU init, and on the
+        # healthy path it would pay backend init twice).
+        platform = os.environ["JAX_PLATFORMS"].split(",")[0]
+        if platform == "cpu":
+            pin_cpu()
+        note = f"{platform} (env-pinned)"
+    else:
+        platform = probe_default_platform()
+        if platform is None or platform == "cpu":
+            pin_cpu()
+            note = ("cpu (default backend probe failed/timed out — TPU "
+                    "unreachable, degraded to host CPU)"
+                    if platform is None else "cpu (default backend)")
+        else:
+            note = f"{platform} (default backend)"
+    run_bench(n_histories, n_ops, note)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except (KeyboardInterrupt, SystemExit):
+        raise  # an interrupted run must not masquerade as a measured rc=0
+    except Exception as e:  # noqa: BLE001 — the artifact must exist
+        fail(f"{type(e).__name__}: {e}",
+             traceback=traceback.format_exc(limit=20))
+        sys.exit(0)

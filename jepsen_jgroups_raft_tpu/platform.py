@@ -1,0 +1,40 @@
+"""CPU-platform pinning for JAX, shared by every entry point.
+
+The TPU plugin ('axon') is registered by sitecustomize at interpreter
+start, which imports jax — so setting JAX_PLATFORMS in os.environ alone is
+too late, and if the TPU tunnel is wedged, the first jax.devices() blocks
+forever inside backend init (round-1 rc=124). Pinning must therefore
+update jax.config directly, and XLA_FLAGS must be set before the CPU
+backend itself initializes. Used by tests/conftest.py, bench.py, and
+__graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_cpu(n_devices: int = 8) -> None:
+    """Force JAX onto a virtual `n_devices`-device CPU platform.
+
+    Must run before the CPU backend initializes to control the device
+    count (afterwards the pin still keeps the TPU backend from ever
+    initializing, but the existing device count wins). An XLA_FLAGS count
+    already present is raised to `n_devices` if smaller.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

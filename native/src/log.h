@@ -12,8 +12,13 @@
 // Indexing is 1-based like the Raft paper; index 0 = empty-log sentinel.
 #pragma once
 
+#include <cerrno>
 #include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
 #include <sys/stat.h>
+#include <unistd.h>
 
 #include <fstream>
 #include <mutex>
@@ -80,17 +85,53 @@ class RaftLog {
   std::string meta_path() const { return dir_ + "/meta"; }
   std::string log_path() const { return dir_ + "/log"; }
 
+  // Durability: votes and entries are fsync'd (file AND directory) before
+  // they are acted on — a persisted vote/append must survive not just
+  // SIGKILL (the nemesis's scope) but an OS crash, or a rebooted node
+  // could double-vote in a term (round-2 advisor finding; matches the
+  // reference SUT's FileBasedLog fsync-backed contract). Persistence
+  // failure (ENOSPC/EIO) is FAIL-STOP: by the time set_term_vote/append
+  // returns, the caller acts on the state (grants the vote, acks the
+  // entries), so "persisted" must be true — a node that cannot persist
+  // must die rather than keep participating, and the harness's
+  // crash-recovery machinery handles the corpse like any :kill victim.
+  [[noreturn]] static void die(const char* what) {
+    std::fprintf(stderr, "[raftlog] FATAL: %s: %s\n", what,
+                 std::strerror(errno));
+    std::abort();
+  }
+
+  static void write_all(int f, const Bytes& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t n = ::write(f, data.data() + off, data.size() - off);
+      if (n < 0 && errno == EINTR) continue;
+      if (n <= 0) die("log write failed");
+      off += static_cast<size_t>(n);
+    }
+  }
+
+  void fsync_dir() const {
+    int d = ::open(dir_.c_str(), O_RDONLY | O_DIRECTORY);
+    if (d < 0) return;
+    ::fsync(d);
+    ::close(d);
+  }
+
   void persist_meta() {
     if (dir_.empty()) return;
     Buf b;
     b.u64(current_term_);
     b.str(voted_for_);
     std::string tmp = meta_path() + ".tmp";
-    {
-      std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
-      f.write(b.s.data(), static_cast<std::streamsize>(b.s.size()));
-    }
-    ::rename(tmp.c_str(), meta_path().c_str());
+    int f = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (f < 0) die("meta open failed");
+    write_all(f, b.s);
+    if (::fsync(f) != 0) die("meta fsync failed");
+    ::close(f);
+    if (::rename(tmp.c_str(), meta_path().c_str()) != 0)
+      die("meta rename failed");
+    fsync_dir();  // the rename itself must survive an OS crash
   }
 
   void load_meta() {
@@ -120,22 +161,26 @@ class RaftLog {
 
   void persist_append(const LogEntry& e) {
     if (dir_.empty()) return;
-    std::ofstream f(log_path(), std::ios::binary | std::ios::app);
-    Bytes rec = encode_entry(e);
-    f.write(rec.data(), static_cast<std::streamsize>(rec.size()));
+    bool fresh = ::access(log_path().c_str(), F_OK) != 0;
+    int f = ::open(log_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (f < 0) die("log open failed");
+    write_all(f, encode_entry(e));
+    if (::fsync(f) != 0) die("log fsync failed");
+    ::close(f);
+    if (fresh) fsync_dir();  // file creation must survive an OS crash
   }
 
   void rewrite() {
     if (dir_.empty()) return;
     std::string tmp = log_path() + ".tmp";
-    {
-      std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
-      for (const auto& e : entries_) {
-        Bytes rec = encode_entry(e);
-        f.write(rec.data(), static_cast<std::streamsize>(rec.size()));
-      }
-    }
-    ::rename(tmp.c_str(), log_path().c_str());
+    int f = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (f < 0) die("log rewrite open failed");
+    for (const auto& e : entries_) write_all(f, encode_entry(e));
+    if (::fsync(f) != 0) die("log rewrite fsync failed");
+    ::close(f);
+    if (::rename(tmp.c_str(), log_path().c_str()) != 0)
+      die("log rewrite rename failed");
+    fsync_dir();
   }
 
   void load_entries() {

@@ -122,27 +122,37 @@ class Transport {
  private:
   // One outbound connection per peer: bounded queue + sender thread with
   // lazy reconnect. Send failure drops the frame (Raft retries by cadence).
-  struct Link {
+  //
+  // Lifetime: the sender thread holds a shared_ptr to its own Link
+  // (shared_from_this), so stop() can drop the map's reference — on address
+  // change (sync_transport_addresses) or transport shutdown — without the
+  // detached thread ever touching a destroyed mutex/condvar (round-2
+  // advisor finding). Only the loop thread ever closes `fd`; stop() only
+  // shutdown()s it to wake a blocked send, and every fd transition happens
+  // under qmu so stop can never shut down a recycled descriptor that close
+  // already returned to the kernel.
+  struct Link : std::enable_shared_from_this<Link> {
     std::string self, peer, host;
     int port = 0;
-    std::mutex qmu;
+    std::mutex qmu;  // guards queue AND fd transitions
     std::condition_variable qcv;
     std::deque<Bytes> queue;
     std::atomic<bool> alive{false};
     int fd = -1;
-    std::thread thread;
     static constexpr size_t kMaxQueue = 4096;
 
     void run() {
       alive = true;
-      thread = std::thread([this] { loop(); });
+      std::thread([self = shared_from_this()] { self->loop(); }).detach();
     }
 
     void stop() {
       alive = false;
+      {
+        std::lock_guard<std::mutex> g(qmu);
+        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);  // wake a blocked send
+      }
       qcv.notify_all();
-      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
-      if (thread.joinable()) thread.detach();  // loop exits on alive=false
     }
 
     void enqueue(Bytes payload) {
@@ -152,9 +162,15 @@ class Transport {
       qcv.notify_one();
     }
 
+    void close_fd_locked() {
+      if (fd >= 0) ::close(fd);
+      fd = -1;
+    }
+
     void loop() {
       while (alive) {
         Bytes frame;
+        int cfd;
         {
           std::unique_lock<std::mutex> g(qmu);
           qcv.wait_for(g, std::chrono::milliseconds(200),
@@ -163,22 +179,28 @@ class Transport {
           if (queue.empty()) continue;
           frame = std::move(queue.front());
           queue.pop_front();
+          cfd = fd;
         }
         try {
-          if (fd < 0) {
-            fd = connect_to(host, port, 250);
+          if (cfd < 0) {
+            cfd = connect_to(host, port, 250);
+            {
+              std::lock_guard<std::mutex> g(qmu);
+              fd = cfd;  // published before use so stop() can interrupt it
+            }
             Buf hello;
             hello.u8(wire::P_HELLO);
             hello.str(self);
-            send_frame(fd, hello.s);
+            send_frame(cfd, hello.s);
           }
-          send_frame(fd, frame);
+          send_frame(cfd, frame);
         } catch (const WireError&) {
-          if (fd >= 0) ::close(fd);
-          fd = -1;  // frame dropped; raft cadence re-sends
+          std::lock_guard<std::mutex> g(qmu);
+          close_fd_locked();  // frame dropped; raft cadence re-sends
         }
       }
-      if (fd >= 0) ::close(fd);
+      std::lock_guard<std::mutex> g(qmu);
+      close_fd_locked();
     }
   };
 

@@ -82,17 +82,25 @@ class RaftNode {
       : opt_(std::move(opt)), sm_(sm), tr_(tr), rng_(std::random_device{}()) {}
 
   void start() {
-    log_.open(opt_.log_dir, opt_.name);
-    config_ = opt_.initial_members;
-    // Recovered log may contain a newer committed config; adopt the last one.
-    for (uint64_t i = log_.last_index(); i >= 1; --i) {
-      if (log_.at(i).type == wire::E_CONFIG) {
-        config_ = decode_config(log_.at(i).data);
-        break;
+    // The transport starts before this (so inbound peer connections are
+    // never refused), which means peer frames can already be arriving —
+    // on_peer_msg drops them until running_, and initialization still runs
+    // under mu_ so the rng_/deadline writes cannot race a handler that
+    // slips in as running_ flips (round-2 TSAN finding: raft.h:95/428).
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      log_.open(opt_.log_dir, opt_.name);
+      config_ = opt_.initial_members;
+      // Recovered log may contain a newer committed config; adopt the last.
+      for (uint64_t i = log_.last_index(); i >= 1; --i) {
+        if (log_.at(i).type == wire::E_CONFIG) {
+          config_ = decode_config(log_.at(i).data);
+          break;
+        }
       }
+      sync_transport_addresses();
+      reset_election_deadline();
     }
-    sync_transport_addresses();
-    reset_election_deadline();
     running_ = true;
     ticker_ = std::thread([this] { tick_loop(); });
     applier_ = std::thread([this] { apply_loop(); });
@@ -144,6 +152,8 @@ class RaftNode {
   void on_peer_msg(const std::string& sender, uint8_t type, Reader& r) {
     (void)sender;  // messages carry their own sender fields; the transport
                    // argument exists for receive-side partition filtering
+    if (!running_) return;  // not yet started / shutting down: drop (the
+                            // heartbeat cadence re-delivers anything lost)
     switch (type) {
       case wire::P_VOTE_REQ:
         handle_vote_req(r);
@@ -504,7 +514,13 @@ class RaftNode {
             if (etype == wire::E_CONFIG) adopt_config(data);
           }
           match = idx;
-          uint64_t new_commit = std::min(leader_commit, log_.last_index());
+          // Clamp to the index of the last entry VERIFIED by this RPC
+          // (prev_idx + count), not our whole log: with the kMaxBatch
+          // window, last_index() can cover a stale divergent tail from an
+          // old term that this RPC never checked — committing into it would
+          // apply entries that differ from the leader's log (Raft fig. 2,
+          // "min(leaderCommit, index of last new entry)").
+          uint64_t new_commit = std::min(leader_commit, idx);
           if (new_commit > commit_index_) {
             commit_index_ = new_commit;
             notify_apply = true;

@@ -1,10 +1,13 @@
-"""Run the native server under ThreadSanitizer and assert zero reports.
+"""Run the native server under ThreadSanitizer/AddressSanitizer and assert
+zero reports.
 
 SURVEY.md §5.2: the reference leans on JVM memory safety; this build's C++
 tier gets the sanitizer treatment instead. The cluster runs a concurrent
 op mix with a leader kill and a partition (the thread-interaction hot
 paths: ticker vs transport readers vs apply loop vs client conns), then
-every node log is scanned for TSAN warnings.
+every node log is scanned for sanitizer warnings. A config-adoption churn
+(add/remove of a member) is included because it re-creates transport Links,
+the sender-thread lifetime edge ASAN watches.
 
 Set SKIP_TSAN=1 to skip (e.g. on machines without sanitizer runtimes).
 """
@@ -15,71 +18,111 @@ import time
 
 import pytest
 
-from jepsen_jgroups_raft_tpu.deploy.local import BlockNet, LocalCluster
+from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
+                                                  wait_for_port)
 from jepsen_jgroups_raft_tpu.native import NATIVE_DIR, ensure_built
-from jepsen_jgroups_raft_tpu.native.client import NativeRsmConn
+from jepsen_jgroups_raft_tpu.native.client import NativeConn, NativeRsmConn
 
 NODES = ["n1", "n2", "n3"]
+
+MARKERS = {
+    "tsan": ("WARNING: ThreadSanitizer",),
+    # No LeakSanitizer marker: every node exit here is SIGKILL, so LSAN's
+    # atexit check never runs — listing it would claim coverage that
+    # doesn't exist.
+    "asan": ("ERROR: AddressSanitizer",),
+}
+
+
+def _run_faulted_workload(cluster):
+    for n in NODES:
+        cluster.start_node(n, NODES, wait=False)
+    for n in NODES:
+        wait_for_port(*cluster.resolve(n), timeout=30.0)
+
+    stop = time.monotonic() + 6.0
+
+    def worker(node, k):
+        conn = NativeRsmConn(*cluster.resolve(node), timeout=2.0)
+        try:
+            i = 0
+            while time.monotonic() < stop:
+                i += 1
+                try:
+                    conn.put(k, i)
+                    conn.get(k, quorum=(i % 2 == 0))
+                    conn.cas(k, i, i + 1)
+                except Exception:
+                    time.sleep(0.05)  # elections/faults in progress
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(n, k))
+               for k, n in enumerate(NODES * 2)]
+    for t in threads:
+        t.start()
+    # poke the thread-interaction paths while ops fly
+    time.sleep(1.0)
+    net = BlockNet(cluster)
+    test = {"nodes": NODES, "members": set(NODES)}
+    net.partition(test, {"n1": {"n2", "n3"}, "n2": {"n1"}, "n3": {"n1"}})
+    time.sleep(1.0)
+    net.heal(test)
+    time.sleep(0.5)
+    cluster.kill_node("n2")
+    time.sleep(1.0)
+    cluster.start_node("n2", NODES)
+
+    # Membership churn WITH an address change: kill n3, remove it from the
+    # cluster, re-add it on fresh ports. Peers' config adoption then calls
+    # Transport::set_address with a changed host:port, destroying and
+    # re-creating the n3 Link while its sender thread may be mid-send —
+    # the detached-thread lifetime edge the ASAN build watches.
+    cluster.kill_node("n3")  # kill-before-remove (membership.clj:87-92)
+    admin = NativeConn(*cluster.resolve("n1"), timeout=3.0)
+    try:
+        _admin_retry(lambda: admin.admin_remove("n3"))
+        cluster.ports.pop("n3", None)  # n3 comes back on new ports
+        _admin_retry(lambda: admin.admin_add(cluster.spec("n3")))
+    finally:
+        admin.close()
+    cluster.start_node("n3", NODES)
+
+    for t in threads:
+        t.join()
+
+
+def _admin_retry(fn, deadline_s=15.0):
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return fn()
+        except Exception:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.3)
 
 
 @pytest.mark.skipif(os.environ.get("SKIP_TSAN") == "1",
                     reason="SKIP_TSAN=1")
-def test_native_server_is_race_clean_under_tsan(tmp_path):
-    ensure_built(san="tsan")
+@pytest.mark.parametrize("san", ["tsan", "asan"])
+def test_native_server_is_clean_under_sanitizer(tmp_path, san):
+    ensure_built(san=san)
     cluster = LocalCluster(
         NODES, sm="map", workdir=str(tmp_path / "sut"),
         election_ms=300, heartbeat_ms=100, repl_timeout_ms=5000,
-        server_bin=str(NATIVE_DIR / "build-tsan" / "raft_server"))
+        server_bin=str(NATIVE_DIR / f"build-{san}" / "raft_server"))
     try:
-        for n in NODES:
-            cluster.start_node(n, NODES, wait=False)
-        from jepsen_jgroups_raft_tpu.deploy.local import wait_for_port
-        for n in NODES:
-            wait_for_port(*cluster.resolve(n), timeout=30.0)
-
-        stop = time.monotonic() + 6.0
-
-        def worker(node, k):
-            conn = NativeRsmConn(*cluster.resolve(node), timeout=2.0)
-            try:
-                i = 0
-                while time.monotonic() < stop:
-                    i += 1
-                    try:
-                        conn.put(k, i)
-                        conn.get(k, quorum=(i % 2 == 0))
-                        conn.cas(k, i, i + 1)
-                    except Exception:
-                        time.sleep(0.05)  # elections/faults in progress
-            finally:
-                conn.close()
-
-        threads = [threading.Thread(target=worker, args=(n, k))
-                   for k, n in enumerate(NODES * 2)]
-        for t in threads:
-            t.start()
-        # poke the thread-interaction paths while ops fly
-        time.sleep(1.0)
-        net = BlockNet(cluster)
-        test = {"nodes": NODES, "members": set(NODES)}
-        net.partition(test, {"n1": {"n2", "n3"}, "n2": {"n1"},
-                             "n3": {"n1"}})
-        time.sleep(1.0)
-        net.heal(test)
-        time.sleep(0.5)
-        cluster.kill_node("n2")
-        time.sleep(1.0)
-        cluster.start_node("n2", NODES)
-        for t in threads:
-            t.join()
+        _run_faulted_workload(cluster)
     finally:
         cluster.shutdown()
 
     reports = []
     for n in NODES:
         text = cluster.log_path(n).read_text(errors="replace")
-        if "WARNING: ThreadSanitizer" in text:
-            # keep just the headline lines for the assertion message
-            reports += [ln for ln in text.splitlines()
-                        if "WARNING: ThreadSanitizer" in ln][:5]
-    assert not reports, f"TSAN reports in server logs: {reports}"
+        for marker in MARKERS[san]:
+            if marker in text:
+                # keep just the headline lines for the assertion message
+                reports += [ln for ln in text.splitlines()
+                            if marker in ln][:5]
+    assert not reports, f"{san} reports in server logs: {reports}"
