@@ -140,6 +140,14 @@ def main() -> None:
         platform = os.environ["JGRAFT_BENCH_PLATFORM"]
         if platform == "cpu":
             pin_cpu()
+        else:
+            # Actually pin the named platform — otherwise the default
+            # backend would initialize instead (and can hang: round-1
+            # rc=124 had no timeout on this path).
+            os.environ["JAX_PLATFORMS"] = platform
+            import jax
+
+            jax.config.update("jax_platforms", platform)
         note = f"forced:{platform}"
     elif os.environ.get("JAX_PLATFORMS"):
         # Platform already pinned by the environment: no probe needed (the

@@ -35,7 +35,8 @@ import numpy as np
 
 from ..history.ops import History
 from ..history.packing import EncodedHistory, encode_history, pack_batch
-from ..ops.linear_scan import DEFAULT_N_CONFIGS, MAX_SLOTS, make_batch_checker
+from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
+                               make_batch_checker)
 from .base import Checker, INVALID, UNKNOWN, VALID
 from .wgl_cpu import FrontierOverflow, check_encoded_cpu
 
@@ -78,8 +79,8 @@ def check_histories(
         for i in trivial:
             results[i] = {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
         if fits:
-            eff_slots = n_slots or min(
-                MAX_SLOTS, _bucket(max(encs[i].n_slots for i in fits), 8)
+            eff_slots = n_slots or bucket_slots(
+                max(encs[i].n_slots for i in fits)
             )
             # Capacity ladder: per-event work is linear in the frontier
             # capacity C, and a "valid" at small C is final (overflow can

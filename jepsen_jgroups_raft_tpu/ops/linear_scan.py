@@ -4,14 +4,15 @@ The Wing&Gong/Lowe linear search (knossos' :linear algorithm — the
 reference's checker engine, register.clj:110-111 / SURVEY.md §3.4) recast as
 a fixed-shape scan that XLA compiles onto the TPU vector unit:
 
-  * A search **configuration** is (uint32 bitmask over ≤32 concurrency-window
-    slots, int32 model state). The frontier is a fixed-capacity array of
-    C configurations; empty entries carry a sentinel mask.
+  * A search **configuration** is (K-word uint32 bitmask over ≤W
+    concurrency-window slots, int32 model state). The frontier is a
+    fixed-capacity array of C configurations; empty entries carry an
+    all-ones sentinel mask.
   * The packed event stream (history/packing.py) is scanned with `lax.scan`.
     OPEN events update per-slot op registers; FORCE events run a closure:
     expand every configuration by every open un-linearized slot — a single
     branch-free [C, W] evaluation of the model's vectorized step — then
-    deduplicate by a 2-key `lax.sort` and compact, repeating under
+    deduplicate by a multi-key `lax.sort` and compact, repeating under
     `lax.while_loop` until the frontier stops growing.
   * Dedup-by-sort is the memoization: it plays the role of knossos'
     visited-configuration hash set, but as a data-parallel primitive with
@@ -23,6 +24,15 @@ a fixed-shape scan that XLA compiles onto the TPU vector unit:
   * `vmap` lifts everything over a batch of histories; `parallel/` shards
     the batch over the device mesh.
 
+Masks are multi-word (K = W // 32 + 1 uint32 lanes, bit i of word j =
+slot 32j+i), lifting the round-1 31-slot window cap: the reference's
+documented runs use --concurrency 100 (reference doc/running.md:88), and
+timeout-polluted histories hold slots open indefinitely — exactly the
+regime that must stay on-device. K is chosen so the last word always has
+at least one unused top bit, keeping the all-ones empty-entry sentinel
+distinct from every reachable configuration (soundness: a fully-set mask
+can never be silently dropped as "empty").
+
 Why closure only at FORCE events is sound: between two completions no
 real-time precedence edge can appear (all open ops are mutually concurrent),
 so deferring expansion from OPEN events to the next FORCE reaches the
@@ -31,8 +41,6 @@ identical configuration set — see history/packing.py.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -40,36 +48,47 @@ from jax import lax
 
 from ..history.packing import EV_FORCE, EV_OPEN
 
-#: Hard window cap: masks are uint32, and bit 31 is reserved so that a
-#: fully-linearized 31-slot mask can never equal the all-ones empty-entry
-#: sentinel (a 32-slot config with every bit set WOULD collide with _SENT
-#: and be silently dropped — a soundness hole). Histories needing more
-#: concurrent slots (incl. never-retiring info ops) fall back to the CPU
-#: checker, whose masks are arbitrary-precision.
-MAX_SLOTS = 31
+#: Hard window cap (4 mask words). Histories needing more concurrent slots
+#: (incl. never-retiring info ops) fall back to the CPU checker, whose
+#: masks are arbitrary-precision.
+MAX_SLOTS = 127
+
+#: Window sizes worth compiling: snug sizes for typical histories, then
+#: word-boundary maxima (32k-1 slots per k words). check_histories buckets
+#: each batch's real window up to the next rung.
+SLOT_BUCKETS = (8, 16, 31, 63, 127)
 
 DEFAULT_N_CONFIGS = 256
 
-# Empty-frontier-entry sentinel mask. A NumPy (not jnp) scalar on purpose:
-# a module-level jnp constant would initialize the JAX backend at import
-# time, hanging importers when the accelerator is unreachable and
+# Empty-frontier-entry sentinel mask word. A NumPy (not jnp) scalar on
+# purpose: a module-level jnp constant would initialize the JAX backend at
+# import time, hanging importers when the accelerator is unreachable and
 # defeating late platform pinning (cli --platform).
 _SENT = np.uint32(0xFFFFFFFF)
 
 
 def _dedup_compact(masks, states, n_configs):
-    """Sort (mask, state) pairs, drop duplicates & sentinels, compact the
-    first n_configs into a fresh frontier. Returns (masks', states', count,
-    overflowed)."""
-    sm, ss = lax.sort((masks, states), num_keys=2)
-    first = jnp.concatenate([jnp.array([True]), (sm[1:] != sm[:-1]) | (ss[1:] != ss[:-1])])
-    keep = first & (sm != _SENT)
+    """Sort (mask-words…, state) tuples, drop duplicates & sentinels,
+    compact the first n_configs into a fresh frontier. masks: [N, K].
+    Returns (masks', states', count, overflowed)."""
+    K = masks.shape[1]
+    cols = tuple(masks[:, j] for j in range(K)) + (states,)
+    sorted_cols = lax.sort(cols, num_keys=K + 1)
+    sm = jnp.stack(sorted_cols[:-1], axis=1)  # [N, K]
+    ss = sorted_cols[-1]
+    diff = jnp.any(sm[1:] != sm[:-1], axis=1) | (ss[1:] != ss[:-1])
+    first = jnp.concatenate([jnp.array([True]), diff])
+    # Empty entries are all-ones; the last word alone suffices as the test
+    # (its top bit is never set in a reachable config, by choice of K).
+    keep = first & (sm[:, K - 1] != _SENT)
     pos = jnp.cumsum(keep) - 1
     count = jnp.sum(keep)
     overflow = count > n_configs
     idx = jnp.where(keep & (pos < n_configs), pos, n_configs)
-    out_m = jnp.full((n_configs,), _SENT, dtype=jnp.uint32).at[idx].set(sm, mode="drop")
-    out_s = jnp.zeros((n_configs,), dtype=jnp.int32).at[idx].set(ss, mode="drop")
+    out_m = jnp.full((n_configs, K), _SENT,
+                     dtype=jnp.uint32).at[idx].set(sm, mode="drop")
+    out_s = jnp.zeros((n_configs,), dtype=jnp.int32).at[idx].set(
+        ss, mode="drop")
     return out_m, out_s, jnp.minimum(count, n_configs), overflow
 
 
@@ -79,27 +98,35 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
 
     Returns fn(events:[E,5] int32) -> (valid: bool, overflow: bool).
     `model` supplies the vectorized `jax_step` and initial state; `n_configs`
-    (C) and `n_slots` (W ≤ 32) fix the kernel shape.
+    (C) and `n_slots` (W ≤ MAX_SLOTS) fix the kernel shape.
     """
     if n_slots > MAX_SLOTS:
         raise ValueError(f"n_slots {n_slots} > {MAX_SLOTS}")
     C, W = int(n_configs), int(n_slots)
+    K = W // 32 + 1  # last word always keeps ≥1 spare bit (sentinel safety)
     init_state = jnp.int32(model.init_state())
     slot_ids = jnp.arange(W, dtype=jnp.int32)
-    slot_bits = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))  # [W]
+    slot_word = np.arange(W) // 32  # [W] static
+    slot_bit = (jnp.uint32(1) << (jnp.arange(W, dtype=jnp.uint32) % 32))
+    # [W, K] bit pattern that sets slot w's bit in its word, 0 elsewhere.
+    word_onehot = jnp.asarray(
+        (np.arange(K)[None, :] == slot_word[:, None]), dtype=jnp.uint32)
+    set_bits = word_onehot * slot_bit[:, None]  # [W, K]
+    sent_row = jnp.full((K,), _SENT, dtype=jnp.uint32)
 
     def expand_once(masks, states, count, overflow, slot_f, slot_a, slot_b,
                     slot_open):
-        live = masks != _SENT  # [C]
-        m = masks[:, None]  # [C,1]
+        live = masks[:, K - 1] != _SENT  # [C]
         s = states[:, None]
-        candidate_open = slot_open[None, :] & ((m & slot_bits[None, :]) == 0)
+        m_w = masks[:, slot_word]  # [C, W] the word holding each slot's bit
+        candidate_open = slot_open[None, :] & ((m_w & slot_bit[None, :]) == 0)
         ns, legal = model.jax_step(s, slot_f[None, :], slot_a[None, :],
                                    slot_b[None, :])
-        good = live[:, None] & candidate_open & legal  # [C,W]
-        cand_m = jnp.where(good, m | slot_bits[None, :], _SENT)
+        good = live[:, None] & candidate_open & legal  # [C, W]
+        cand = masks[:, None, :] | set_bits[None, :, :]  # [C, W, K]
+        cand_m = jnp.where(good[:, :, None], cand, sent_row)  # [C, W, K]
         cand_s = jnp.where(good, ns, 0).astype(jnp.int32)
-        all_m = jnp.concatenate([masks, cand_m.reshape(-1)])
+        all_m = jnp.concatenate([masks, cand_m.reshape(-1, K)])
         all_s = jnp.concatenate([states, cand_s.reshape(-1)])
         nm, nstates, ncount, of = _dedup_compact(all_m, all_s, C)
         return nm, nstates, ncount, overflow | of
@@ -145,12 +172,17 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         # FORCE: survivors have the slot's bit; then the bit is recycled.
         # Liveness guard matters: sentinel entries have every bit set and
         # must not masquerade as survivors.
-        bit = jnp.uint32(1) << slot.astype(jnp.uint32)
-        live = masks != _SENT
-        has = ((masks & bit) != 0) & live
-        killed_m = jnp.where(is_force & live & ~has, _SENT, masks)
-        cleared_m = jnp.where(is_force & has, killed_m & ~bit, killed_m)
-        alive = jnp.any(cleared_m != _SENT)
+        bitvec = jnp.where(
+            jnp.arange(K) == slot // 32,
+            jnp.uint32(1) << (slot % 32).astype(jnp.uint32),
+            jnp.uint32(0))  # [K]
+        live = masks[:, K - 1] != _SENT
+        has = jnp.any((masks & bitvec[None, :]) != 0, axis=1) & live
+        killed_m = jnp.where((is_force & live & ~has)[:, None],
+                             sent_row, masks)
+        cleared_m = jnp.where((is_force & has)[:, None],
+                              killed_m & ~bitvec[None, :], killed_m)
+        alive = jnp.any(cleared_m[:, K - 1] != _SENT)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~(onehot & is_force)
         # Clearing the recycled bit can merge configurations; re-dedup so the
@@ -161,7 +193,8 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
                 ok, overflow), None
 
     def check(events):
-        masks = jnp.full((C,), _SENT, dtype=jnp.uint32).at[0].set(jnp.uint32(0))
+        masks = jnp.full((C, K), _SENT, dtype=jnp.uint32).at[0].set(
+            jnp.zeros((K,), dtype=jnp.uint32))
         states = jnp.zeros((C,), dtype=jnp.int32).at[0].set(init_state)
         carry = (
             masks, states, jnp.int32(1),
@@ -176,6 +209,14 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         return ok, overflow
 
     return check
+
+
+def bucket_slots(n: int) -> int:
+    """Smallest SLOT_BUCKETS rung ≥ n (kernel-shape quantization)."""
+    for b in SLOT_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"window {n} exceeds MAX_SLOTS {MAX_SLOTS}")
 
 
 _KERNEL_CACHE: dict = {}

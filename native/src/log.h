@@ -113,8 +113,8 @@ class RaftLog {
 
   void fsync_dir() const {
     int d = ::open(dir_.c_str(), O_RDONLY | O_DIRECTORY);
-    if (d < 0) return;
-    ::fsync(d);
+    if (d < 0) die("log dir open failed");
+    if (::fsync(d) != 0) die("log dir fsync failed");
     ::close(d);
   }
 

@@ -184,10 +184,19 @@ class Transport {
         try {
           if (cfd < 0) {
             cfd = connect_to(host, port, 250);
+            bool bail = false;
             {
               std::lock_guard<std::mutex> g(qmu);
               fd = cfd;  // published before use so stop() can interrupt it
+              if (!alive) {
+                // stop() ran between our fd=-1 read and this publish: its
+                // shutdown() was a no-op, so nothing would ever wake a
+                // blocked send — bail out ourselves.
+                close_fd_locked();
+                bail = true;
+              }
             }
+            if (bail) break;
             Buf hello;
             hello.u8(wire::P_HELLO);
             hello.str(self);

@@ -250,6 +250,65 @@ def test_differential_random_histories(model_kind):
         assert got_jax == expected, f"jax mismatch on case {i}"
 
 
+def _cas_chain_history(width, procs_offset=0, break_at=None):
+    """`width` mutually-concurrent cas ops chained 0→1→…→width, all invoked
+    before any completes (concurrency window = width). From state k only
+    cas(k→k+1) is legal, so the frontier stays ≈width+1 configs — wide
+    window WITHOUT frontier explosion, isolating the multi-word-mask path.
+    break_at=j makes cas_j expect the wrong from-value (invalid history)."""
+    from jepsen_jgroups_raft_tpu.history.ops import Op
+
+    rows = [Op(500, INVOKE, "write", 0), Op(500, OK, "write", 0)]
+    for i in range(width):
+        frm = i if break_at != i else i + 500  # unsatisfiable from-value
+        rows.append(Op(procs_offset + i, INVOKE, "cas", (frm, i + 1)))
+    for i in range(width):
+        rows.append(Op(procs_offset + i, OK, "cas",
+                       (i if break_at != i else i + 500, i + 1)))
+    return rows
+
+
+@pytest.mark.parametrize("width", [40, 64, 100])
+def test_wide_window_on_device_matches_cpu(width):
+    """≥64 concurrent open ops decided on-device (multi-word masks — the
+    round-1 31-slot cap is gone; reference runs use --concurrency 100,
+    doc/running.md:88), differential against the unbounded CPU twin."""
+    m = CasRegister()
+    valid = _cas_chain_history(width)
+    invalid = _cas_chain_history(width, break_at=width // 2)
+    encs = [encode_history(h, m) for h in (valid, invalid)]
+    assert encs[0].n_slots >= width
+    kernel = make_batch_checker(m, n_configs=2 * width + 8,
+                                n_slots=encs[0].n_slots)
+    batch = pack_batch(encs)
+    ok, overflow = kernel(batch["events"])
+    assert not np.asarray(overflow).any()
+    assert bool(ok[0]) is True
+    assert bool(ok[1]) is False
+    assert check_encoded_cpu(encs[0], m).valid is True
+    assert check_encoded_cpu(encs[1], m).valid is False
+
+
+def test_wide_window_with_info_ops_auto_stays_on_device():
+    """Crashed (info) ops hold slots forever — the exact checker-pressure
+    regime the reference documents (doc/intro.md:35-41). 50 crashed chained
+    cas ops + live traffic: window >31, auto must decide it on-device."""
+    from jepsen_jgroups_raft_tpu.history.ops import Op
+
+    rows = [Op(500, INVOKE, "write", 0), Op(500, OK, "write", 0)]
+    for i in range(50):
+        rows.append(Op(i, INVOKE, "cas", (i, i + 1)))  # never completes
+    rows.append(Op(600, INVOKE, "read", None))
+    rows.append(Op(600, OK, "read", 7))  # chain linearized up to 7
+    for i in range(50):
+        rows.append(Op(i, INFO, "cas", (i, i + 1)))
+    results = check_histories([rows], CasRegister(), algorithm="auto",
+                              n_configs=256)
+    assert results[0]["valid?"] is True
+    assert results[0]["algorithm"] == "jax"
+    assert results[0]["concurrency-window"] > 31
+
+
 def test_uncorrupted_random_histories_always_valid():
     rng = random.Random(7)
     m = CasRegister()

@@ -62,18 +62,19 @@ class TestTaxonomy:
 
 
 class TestKernelCapacity:
-    def test_kernel_rejects_window_wider_than_31(self):
-        # Bit 31 is reserved: a 32-slot all-linearized mask would equal the
-        # empty-entry sentinel and be dropped — a soundness hole found by
-        # review; the kernel must refuse rather than mis-verdict.
+    def test_kernel_rejects_window_wider_than_cap(self):
+        # The last mask word always keeps a spare top bit (K = W//32 + 1),
+        # so a fully-linearized mask can never equal the empty-entry
+        # sentinel — the kernel refuses windows beyond MAX_SLOTS rather
+        # than risking a mis-verdict.
         with pytest.raises(ValueError):
-            make_history_checker(CasRegister(), n_slots=32)
-        assert MAX_SLOTS == 31
+            make_history_checker(CasRegister(), n_slots=MAX_SLOTS + 1)
+        assert MAX_SLOTS == 127
 
-    def test_wide_history_falls_back_to_cpu(self):
-        # 33 concurrent crashed cas ops chained 0->1->...->33 + one ok read:
-        # window exceeds the kernel cap; auto mode must still verify it
-        # (CPU fallback), and the verdict must be valid.
+    def test_33_wide_window_stays_on_device(self):
+        # 33 concurrent crashed cas ops chained 0->1->...->33 + one ok
+        # read: wider than one mask word — round 1 fell back to the CPU
+        # here; the multi-word kernel must now decide it on-device.
         rows = []
         for i in range(33):
             rows.append(Op(i, INVOKE, "cas", (i, i + 1)))
@@ -83,6 +84,21 @@ class TestKernelCapacity:
         seed = [Op(200, INVOKE, "write", 0), Op(200, OK, "write", 0)]
         hist = seed + rows
         r = LinearizableChecker(CasRegister(), algorithm="auto").check({}, hist)
+        assert r["valid?"] is True
+        assert r["algorithm"] == "jax"
+
+    def test_wide_history_falls_back_to_cpu(self):
+        # Window beyond MAX_SLOTS (129 crashed chained cas ops): auto mode
+        # must still answer via the unbounded CPU twin.
+        rows = []
+        for i in range(MAX_SLOTS + 2):
+            rows.append(Op(i, INVOKE, "cas", (i, i + 1)))
+        rows.append(Op(300, INVOKE, "read", None))
+        rows.append(Op(300, OK, "read", 5))
+        seed = [Op(400, INVOKE, "write", 0), Op(400, OK, "write", 0)]
+        hist = seed + rows
+        r = LinearizableChecker(CasRegister(), algorithm="auto",
+                                max_cpu_configs=1 << 20).check({}, hist)
         assert r["valid?"] is True
         assert r["algorithm"] == "cpu"
 
