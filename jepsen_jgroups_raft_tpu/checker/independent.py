@@ -65,13 +65,21 @@ class IndependentLinearizable(Checker):
     def __init__(self, model_factory: Callable[[], Model],
                  algorithm: str = "auto",
                  n_configs: Optional[int] = None,
-                 n_slots: Optional[int] = None):
+                 n_slots: Optional[int] = None,
+                 max_cpu_configs: Optional[int] = None):
+        from .linearizable import DEFAULT_MAX_CPU_CONFIGS
+
         self.model_factory = model_factory
         self.algorithm = algorithm
         self.n_configs = n_configs
         self.n_slots = n_slots
+        self.max_cpu_configs = max_cpu_configs or DEFAULT_MAX_CPU_CONFIGS
 
     def check(self, test, history, opts=None) -> dict:
+        from .linearizable import INVALID
+        from .counterexample import (attach_counterexample,
+                                     write_counterexample_html)
+
         if not isinstance(history, History):
             history = History(history)
         subs = split_by_key(history.client_ops())
@@ -82,7 +90,15 @@ class IndependentLinearizable(Checker):
         rs = check_histories(
             [subs[k] for k in keys], model, self.algorithm,
             self.n_configs, self.n_slots,
+            max_cpu_configs=self.max_cpu_configs,
         )
+        store_dir = (test or {}).get("store_dir")
+        for k, r in zip(keys, rs):
+            if r.get("valid?") is INVALID:
+                attach_counterexample(r, subs[k], model,
+                                      max_cpu_configs=self.max_cpu_configs)
+                write_counterexample_html(r, subs[k], store_dir,
+                                          f"counterexample-{k}.html")
         results = {str(k): r for k, r in zip(keys, rs)}
         return {
             "valid?": merge_valid(r.get("valid?") for r in results.values()),

@@ -10,13 +10,17 @@ Algorithms:
   * ``"jax"``  — pack to event tensors, run the on-device frontier kernel
                  (ops/linear_scan.py); batched across histories.
   * ``"cpu"``  — the unbounded host frontier search (wgl_cpu.py).
+  * ``"dfs"``  — the knossos/porcupine-style DFS-with-undo (dfs_cpu.py):
+                 a genuinely different search order.
+  * ``"race"`` — run the on-device frontier kernel AND the DFS engine
+                 concurrently; per history, the first engine to decide
+                 wins — the knossos.competition/analysis analogue
+                 (reference raft_test.clj:26,41,64: :linear vs :wgl,
+                 first finisher's answer is taken).
   * ``"auto"`` — jax when the history fits the kernel window, with sound
                  escalation: any verdict the kernel cannot certify
                  (window overflow, frontier overflow on an invalid result)
-                 is re-checked on the CPU twin. This mirrors the
-                 reference's algorithm-racing habit (knossos.competition,
-                 raft_test.clj:26) — two engines, the trustworthy answer
-                 wins.
+                 is re-checked on the CPU twin.
 
 Soundness contract: a kernel "valid" is always sound (only reachable
 configurations are ever retained, so a surviving linearization is real); a
@@ -38,6 +42,7 @@ from ..history.packing import EncodedHistory, encode_history, pack_batch
 from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
                                make_batch_checker)
 from .base import Checker, INVALID, UNKNOWN, VALID
+from .dfs_cpu import SearchBudgetExceeded, check_encoded_dfs
 from .wgl_cpu import FrontierOverflow, check_encoded_cpu
 
 
@@ -64,86 +69,191 @@ def check_histories(
     The batch is the unit of TPU work: all histories are packed, padded to a
     common event length, and verified in one vmapped kernel launch.
     n_configs/n_slots default to auto: the concurrency window is sized to
-    the batch's real maximum (bucketed to 8/16/32) — per-event closure work
-    scales with C×W, so a snug window is a direct kernel-speed win.
+    the batch's real maximum (bucketed to SLOT_BUCKETS: 8/16/31/63/127) —
+    per-event closure work scales with C×W, so a snug window is a direct
+    kernel-speed win.
     """
 
     encs = [encode_history(h, model) for h in histories]
     results: list[Optional[dict]] = [None] * len(encs)
 
+    if algorithm == "dfs":
+        return [_check_dfs(e, model, witness,
+                           max_steps=DEFAULT_DFS_BUDGET) for e in encs]
+
+    if algorithm == "race":
+        return _race(encs, model, n_configs, n_slots, witness,
+                     max_cpu_configs)
+
     if algorithm in ("jax", "auto"):
-        cap = n_slots or MAX_SLOTS
-        fits = [i for i, e in enumerate(encs)
-                if e.n_slots <= cap and e.n_events > 0]
-        trivial = [i for i, e in enumerate(encs) if e.n_events == 0]
-        for i in trivial:
-            results[i] = {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
-        if fits:
-            eff_slots = n_slots or bucket_slots(
-                max(encs[i].n_slots for i in fits)
-            )
-            # Capacity ladder: per-event work is linear in the frontier
-            # capacity C, and a "valid" at small C is final (overflow can
-            # only drop configurations, i.e. cause false-INVALID, never
-            # false-VALID) — so run everything at a small C and re-run only
-            # the overflowed minority at full capacity. Typical histories
-            # (bounded concurrency window) decide on the first rung, ~4×
-            # cheaper than launching everything at DEFAULT_N_CONFIGS.
-            ladder = ([n_configs] if n_configs else
-                      [64, DEFAULT_N_CONFIGS] if DEFAULT_N_CONFIGS > 64
-                      else [DEFAULT_N_CONFIGS])
-            remaining = fits
-            for rung, eff_configs in enumerate(ladder):
-                batch = pack_batch([encs[i] for i in remaining])
-                kernel = make_batch_checker(model, eff_configs, eff_slots)
-                # Bucket both compile-shape dims (batch, events) to powers
-                # of two so repeated calls hit the jit cache instead of
-                # recompiling per batch size. Pad rows/events are EV_PAD
-                # no-ops.
-                ev = batch["events"]
-                B, E = ev.shape[0], ev.shape[1]
-                B2, E2 = _bucket(B, 8), _bucket(E, 32)
-                if (B2, E2) != (B, E):
-                    padded = np.zeros((B2, E2, 5), dtype=np.int32)
-                    padded[:B, :E] = ev
-                    ev = padded
-                t0 = time.perf_counter()
-                with _maybe_profile():
-                    ok, overflow = kernel(ev)
-                ok, overflow = ok[:B], overflow[:B]
-                ok = np.asarray(ok)
-                overflow = np.asarray(overflow)
-                dt = time.perf_counter() - t0
-                escalate = []
-                for j, i in enumerate(remaining):
-                    if ok[j]:
-                        results[i] = _jx(VALID, encs[i], dt / len(remaining))
-                    elif not overflow[j]:
-                        results[i] = _jx(INVALID, encs[i],
-                                         dt / len(remaining))
-                    elif rung + 1 < len(ladder):
-                        escalate.append(i)
-                    # else: overflowed at top capacity → undecided,
-                    # fall through to CPU/unknown
-                remaining = escalate
-                if not remaining:
-                    break
-        undecided = [i for i, r in enumerate(results) if r is None]
+        results = _jax_pass(encs, model, n_configs, n_slots)
         if algorithm == "jax":
-            for i in undecided:
-                results[i] = {
-                    "valid?": UNKNOWN,
-                    "algorithm": "jax",
-                    "error": "kernel capacity exceeded "
-                    f"(window {encs[i].n_slots} slots); "
-                    "use algorithm='auto' or 'cpu'",
-                }
+            for i, r in enumerate(results):
+                if r is None:
+                    results[i] = {
+                        "valid?": UNKNOWN,
+                        "algorithm": "jax",
+                        "error": "kernel capacity exceeded "
+                        f"(window {encs[i].n_slots} slots); "
+                        "use algorithm='auto' or 'cpu'",
+                    }
             return results  # type: ignore[return-value]
 
     for i, r in enumerate(results):
         if r is None:
             results[i] = _check_cpu(encs[i], model, witness, max_cpu_configs)
     return results  # type: ignore[return-value]
+
+
+def _jax_pass(encs, model, n_configs=None, n_slots=None):
+    """Run the on-device pass over a batch of encoded histories. Returns a
+    result dict per history, or None where the kernel could not certify a
+    verdict (window beyond MAX_SLOTS, or frontier overflow at top
+    capacity) — the caller escalates those."""
+    results: list[Optional[dict]] = [None] * len(encs)
+    cap = n_slots or MAX_SLOTS
+    fits = [i for i, e in enumerate(encs)
+            if e.n_slots <= cap and e.n_events > 0]
+    for i, e in enumerate(encs):
+        if e.n_events == 0:
+            results[i] = {"valid?": VALID, "algorithm": "trivial",
+                          "op-count": 0}
+    if fits:
+        eff_slots = n_slots or bucket_slots(
+            max(encs[i].n_slots for i in fits)
+        )
+        # Capacity ladder: per-event work is linear in the frontier
+        # capacity C, and a "valid" at small C is final (overflow can
+        # only drop configurations, i.e. cause false-INVALID, never
+        # false-VALID) — so run everything at a small C and re-run only
+        # the overflowed minority at full capacity. Typical histories
+        # (bounded concurrency window) decide on the first rung, ~4×
+        # cheaper than launching everything at DEFAULT_N_CONFIGS.
+        ladder = ([n_configs] if n_configs else
+                  [64, DEFAULT_N_CONFIGS] if DEFAULT_N_CONFIGS > 64
+                  else [DEFAULT_N_CONFIGS])
+        remaining = fits
+        for rung, eff_configs in enumerate(ladder):
+            batch = pack_batch([encs[i] for i in remaining])
+            kernel = make_batch_checker(model, eff_configs, eff_slots)
+            # Bucket both compile-shape dims (batch, events) to powers
+            # of two so repeated calls hit the jit cache instead of
+            # recompiling per batch size. Pad rows/events are EV_PAD
+            # no-ops.
+            ev = batch["events"]
+            B, E = ev.shape[0], ev.shape[1]
+            B2, E2 = _bucket(B, 8), _bucket(E, 32)
+            if (B2, E2) != (B, E):
+                padded = np.zeros((B2, E2, 5), dtype=np.int32)
+                padded[:B, :E] = ev
+                ev = padded
+            t0 = time.perf_counter()
+            with _maybe_profile():
+                ok, overflow = kernel(ev)
+            ok, overflow = ok[:B], overflow[:B]
+            ok = np.asarray(ok)
+            overflow = np.asarray(overflow)
+            dt = time.perf_counter() - t0
+            escalate = []
+            for j, i in enumerate(remaining):
+                if ok[j]:
+                    results[i] = _jx(VALID, encs[i], dt / len(remaining))
+                elif not overflow[j]:
+                    results[i] = _jx(INVALID, encs[i], dt / len(remaining))
+                elif rung + 1 < len(ladder):
+                    escalate.append(i)
+                # else: overflowed at top capacity → undecided (None)
+            remaining = escalate
+            if not remaining:
+                break
+    return results
+
+
+#: DFS step budget in race mode: enough for any history the harness
+#: produces at its scale, small enough that adversarial backtracking
+#: cannot wedge the race (the frontier engines decide those).
+DEFAULT_DFS_BUDGET = 4_000_000
+
+
+def _race(encs, model, n_configs, n_slots, witness, max_cpu_configs):
+    """Race the on-device frontier kernel against the DFS engine; per
+    history the first decided verdict wins (knossos.competition analogue,
+    reference raft_test.clj:26). Histories neither engine decides fall
+    back to the capped CPU frontier — which can itself report UNKNOWN on
+    adversarial histories (the reference community's stance when knossos
+    becomes "unfeasible to verify", doc/intro.md:35-41)."""
+    import threading
+
+    decided: list[Optional[dict]] = [None] * len(encs)
+    lock = threading.Lock()
+
+    def record(i, res):
+        with lock:
+            if decided[i] is None:
+                res["raced"] = True
+                decided[i] = res
+
+    def jax_side():
+        try:
+            rs = _jax_pass(encs, model, n_configs, n_slots)
+        except Exception:
+            # The DFS side carries the race — but never silently: an
+            # always-failing kernel (model bug, shape regression) would
+            # otherwise degrade every race to single-engine unnoticed.
+            import logging
+            logging.getLogger(__name__).warning(
+                "race: jax engine failed, DFS/CPU carries this batch",
+                exc_info=True)
+            return
+        for i, r in enumerate(rs):
+            if r is not None:
+                record(i, r)
+
+    def dfs_side():
+        # Cheapest histories first: win the race where DFS is strong.
+        order = sorted(range(len(encs)), key=lambda i: encs[i].n_events)
+        for i in order:
+            with lock:
+                if decided[i] is not None:
+                    continue
+            r = _check_dfs(encs[i], model, witness,
+                           max_steps=DEFAULT_DFS_BUDGET)
+            if r["valid?"] is not UNKNOWN:
+                record(i, r)
+
+    threads = [threading.Thread(target=jax_side),
+               threading.Thread(target=dfs_side)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, r in enumerate(decided):
+        if r is None:
+            decided[i] = _check_cpu(encs[i], model, witness, max_cpu_configs)
+    return decided
+
+
+def _check_dfs(enc: EncodedHistory, model, witness: bool = False,
+               max_steps: Optional[int] = None) -> dict:
+    if enc.n_events == 0:
+        return {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
+    try:
+        r = check_encoded_dfs(enc, model, max_steps=max_steps,
+                              witness=witness)
+    except SearchBudgetExceeded as e:
+        return {"valid?": UNKNOWN, "algorithm": "dfs", "error": str(e)}
+    out = {
+        "valid?": VALID if r.valid else INVALID,
+        "algorithm": "dfs",
+        "op-count": enc.n_ops,
+        "concurrency-window": enc.n_slots,
+        "configs-explored": r.configs_explored,
+    }
+    if not r.valid:
+        out["failing-op-index"] = r.failing_op_index
+    if r.witness is not None:
+        out["witness"] = r.witness
+    return out
 
 
 def _maybe_profile():
@@ -209,11 +319,23 @@ class LinearizableChecker(Checker):
         self.max_cpu_configs = max_cpu_configs
 
     def check(self, test, history, opts=None) -> dict:
+        from .counterexample import (attach_counterexample,
+                                     write_counterexample_html)
+
         if not isinstance(history, History):
             history = History(history)
         hist = history.client_ops()
+        # witness=True so the host engines produce the explanation during
+        # the verdict run — attach_counterexample then only re-searches
+        # when the kernel (verdict-only) was the decider.
         [result] = check_histories(
             [hist], self.model, self.algorithm, self.n_configs, self.n_slots,
-            max_cpu_configs=self.max_cpu_configs,
+            witness=True, max_cpu_configs=self.max_cpu_configs,
         )
+        if result.get("valid?") is INVALID:
+            attach_counterexample(result, hist, self.model,
+                                  max_cpu_configs=self.max_cpu_configs)
+            write_counterexample_html(result, hist,
+                                      (test or {}).get("store_dir"),
+                                      "counterexample.html")
         return result

@@ -39,7 +39,14 @@ class TimelineChecker(Checker):
         return out
 
 
-def render_timeline(history: History, px_per_s: float = 100.0) -> str:
+def render_timeline(history: History, px_per_s: float = 100.0,
+                    highlight_index: int | None = None,
+                    footer_html: str = "") -> str:
+    """Render the swimlane timeline. `highlight_index` marks the op pair
+    whose invoke or completion has that history index as the violating op
+    (thick red outline) — counterexample rendering, the analogue of the
+    anomaly graphs the reference's stack renders via graphviz
+    (reference bin/docker/control/Dockerfile:13-14)."""
     pairs = history.client_ops().pairs()
     if not pairs:
         return "<html><body>empty history</body></html>"
@@ -59,8 +66,13 @@ def render_timeline(history: History, px_per_s: float = 100.0) -> str:
         label = html_mod.escape(
             f"{p.f} {p.invoke.value!r} -> {typ}"
             + (f" {p.completion.value!r}" if p.completion is not None else ""))
+        hot = highlight_index is not None and (
+            p.invoke.index == highlight_index
+            or (p.completion is not None
+                and p.completion.index == highlight_index))
+        cls = "op bad" if hot else "op"
         rows.append(
-            f"<div class='op' title='{label}' style='left:{left:.0f}px;"
+            f"<div class='{cls}' title='{label}' style='left:{left:.0f}px;"
             f"top:{top}px;width:{width:.0f}px;"
             f"background:{_COLORS.get(typ, '#ddd')}'>{html_mod.escape(str(p.f))}"
             f"</div>")
@@ -72,8 +84,14 @@ def render_timeline(history: History, px_per_s: float = 100.0) -> str:
         "<html><head><style>"
         ".op{position:absolute;height:20px;font-size:10px;overflow:hidden;"
         "border:1px solid #555;border-radius:3px;padding:0 2px;}"
+        ".op.bad{border:3px solid #c00;z-index:2;box-shadow:0 0 6px #c00;}"
         ".lane{position:absolute;left:0;width:75px;font:11px sans-serif;"
         "text-align:right;}"
+        ".footer{position:absolute;left:0;font:12px sans-serif;"
+        "white-space:pre-wrap;}"
         "body{position:relative;font-family:sans-serif;}"
-        f"</style></head><body style='height:{height}px'>"
-        f"{lanes}{''.join(rows)}</body></html>")
+        f"</style></head><body style='height:{height + 20}px'>"
+        f"{lanes}{''.join(rows)}"
+        + (f"<div class='footer' style='top:{height}px'>{footer_html}</div>"
+           if footer_html else "")
+        + "</body></html>")

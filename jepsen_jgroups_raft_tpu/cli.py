@@ -71,8 +71,10 @@ def _add_test_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--store", default="store",
                    help="results directory root")
     p.add_argument("--algorithm", default="auto",
-                   choices=["auto", "jax", "cpu"],
-                   help="linearizability engine (:algorithm :jax analogue)")
+                   choices=["auto", "jax", "cpu", "dfs", "race"],
+                   help="linearizability engine (:algorithm :jax analogue; "
+                        "race = kernel vs DFS, first finisher wins, the "
+                        "knossos.competition analogue)")
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu"],
                    help="pin the JAX backend for checking (e.g. cpu when "

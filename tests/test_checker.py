@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from jepsen_jgroups_raft_tpu.checker.brute import check_brute
+from jepsen_jgroups_raft_tpu.checker.dfs_cpu import check_encoded_dfs
 from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
 from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
 from jepsen_jgroups_raft_tpu.history.ops import INFO, INVOKE, OK, FAIL
@@ -246,6 +247,8 @@ def test_differential_random_histories(model_kind):
         expected = check_brute(h, model)
         got_cpu = check_encoded_cpu(encs[i], model).valid
         assert got_cpu == expected, f"cpu mismatch on case {i}"
+        got_dfs = check_encoded_dfs(encs[i], model).valid
+        assert got_dfs == expected, f"dfs mismatch on case {i}"
         got_jax = jax_verdicts.get(i, True)
         assert got_jax == expected, f"jax mismatch on case {i}"
 
@@ -330,6 +333,60 @@ def test_check_histories_auto_batches_and_falls_back():
     assert any(r["algorithm"] == "jax" for r in results)
 
 
+def test_dfs_differential_on_goldens_and_wide_windows():
+    """DFS engine agrees with the frontier twin on the structured wide
+    histories too (different search order, same verdicts)."""
+    m = CasRegister()
+    for width in (10, 40, 64):
+        for break_at in (None, width // 2):
+            h = _cas_chain_history(width, break_at=break_at)
+            enc = encode_history(h, m)
+            expected = check_encoded_cpu(enc, m).valid
+            assert check_encoded_dfs(enc, m).valid == expected
+
+
+def test_race_returns_first_finisher():
+    """algorithm='race': kernel vs DFS, every history decided, verdicts
+    correct, and results flagged as raced (knossos.competition analogue)."""
+    rng = random.Random(11)
+    m = CasRegister()
+    hs = [random_valid_history(rng, "register", n_ops=12, n_procs=4)
+          for _ in range(6)]
+    hs.append(H(
+        (0, INVOKE, "write", 1),
+        (0, OK, "write", 1),
+        (1, INVOKE, "read", None),
+        (1, OK, "read", 2),
+    ))
+    results = check_histories(hs, m, algorithm="race")
+    for r in results[:-1]:
+        assert r["valid?"] is True
+    assert results[-1]["valid?"] is False
+    assert all(r.get("raced") or r["algorithm"] == "cpu" for r in results)
+    assert {r["algorithm"] for r in results} <= {"jax", "dfs", "cpu"}
+
+
+def test_dfs_witness_and_failing_index():
+    h = H(
+        (0, INVOKE, "add", 1),
+        (0, OK, "add", 1),
+        (1, INVOKE, "read", None),
+        (1, OK, "read", 0),
+    )
+    [r] = check_histories([h], Counter(), algorithm="dfs", witness=True)
+    assert r["valid?"] is False
+    assert r["failing-op-index"] == 3  # the stale read's completion
+    h2 = H(
+        (0, INVOKE, "add", 1),
+        (0, OK, "add", 1),
+        (1, INVOKE, "read", None),
+        (1, OK, "read", 1),
+    )
+    [r2] = check_histories([h2], Counter(), algorithm="dfs", witness=True)
+    assert r2["valid?"] is True
+    assert r2["witness"] == [0, 2]  # linearization order by op index
+
+
 def test_check_histories_cpu_reports_counterexample():
     h = H(
         (0, INVOKE, "add", 1),
@@ -340,3 +397,52 @@ def test_check_histories_cpu_reports_counterexample():
     [r] = check_histories([h], Counter(), algorithm="cpu")
     assert r["valid?"] is False
     assert r["failing-op-index"] == 3  # the stale read's completion
+
+
+def test_counterexample_artifact_rendered(tmp_path):
+    """An invalid verdict explains itself: failing op + witness prefix in
+    the result, and a highlighted-timeline HTML in the store dir — even
+    when the deciding engine was the TPU kernel (which returns only the
+    verdict)."""
+    from jepsen_jgroups_raft_tpu.checker.linearizable import (
+        LinearizableChecker)
+    from jepsen_jgroups_raft_tpu.history.ops import Op
+
+    hist = [
+        Op(0, INVOKE, "write", 1, time=0, index=0),
+        Op(0, OK, "write", 1, time=10, index=1),
+        Op(1, INVOKE, "read", None, time=20, index=2),
+        Op(1, OK, "read", 3, time=30, index=3),  # 3 was never written
+    ]
+    test = {"store_dir": str(tmp_path)}
+    r = LinearizableChecker(CasRegister(), algorithm="jax").check(test, hist)
+    assert r["valid?"] is False
+    ce = r["counterexample"]
+    assert ce["failing-op"]["index"] == 3
+    assert ce["failing-op"]["f"] == "read"
+    assert "no linearization order" in ce["explanation"]
+    assert [v["index"] for v in ce["witness-prefix"]] == [0]  # the write
+    html = (tmp_path / "counterexample.html").read_text()
+    assert "bad" in html and "VIOLATION" in html
+
+
+def test_counterexample_per_key_in_independent(tmp_path):
+    from jepsen_jgroups_raft_tpu.checker.independent import (
+        IndependentLinearizable)
+    from jepsen_jgroups_raft_tpu.history.ops import Op
+
+    hist = [
+        Op(0, INVOKE, "write", (7, 1), time=0, index=0),
+        Op(0, OK, "write", (7, 1), time=10, index=1),
+        Op(1, INVOKE, "read", (7, None), time=20, index=2),
+        Op(1, OK, "read", (7, 2), time=30, index=3),  # stale
+        Op(2, INVOKE, "write", (8, 5), time=0, index=4),
+        Op(2, OK, "write", (8, 5), time=10, index=5),  # key 8 is fine
+    ]
+    test = {"store_dir": str(tmp_path)}
+    r = IndependentLinearizable(CasRegister).check(test, hist)
+    assert r["valid?"] is False
+    assert r["results"]["7"]["valid?"] is False
+    assert "counterexample" in r["results"]["7"]
+    assert r["results"]["8"]["valid?"] is True
+    assert (tmp_path / "counterexample-7.html").exists()
