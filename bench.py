@@ -132,7 +132,129 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     })
 
 
+def run_suite() -> None:
+    """BASELINE.json's five configs at full size, one JSON line each.
+    Operator-invoked (`python bench.py --suite`); the driver's default
+    invocation stays the single north-star line."""
+    import random as _random
+
+    import jax
+
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.models.counter import Counter
+    from jepsen_jgroups_raft_tpu.models.register import CasRegister
+
+    platform = jax.devices()[0].platform
+    # JGRAFT_SUITE_SCALE in (0,1] shrinks every config proportionally —
+    # smoke-testing the suite plumbing without the full-size wall clock.
+    scale = float(os.environ.get("JGRAFT_SUITE_SCALE", "1"))
+
+    def sz(n, floor=1):
+        return max(floor, int(n * scale))
+
+    def timed(name, model, hists, n_configs=128):
+        t0 = time.perf_counter()
+        rs = check_histories(hists, model, algorithm="jax",
+                             n_configs=n_configs)
+        dt = time.perf_counter() - t0
+        bad = [r for r in rs if r["valid?"] is not True]
+        emit({"config": name, "histories": len(hists),
+              "time_s": round(dt, 3),
+              "histories_per_sec": round(len(hists) / dt, 2),
+              "invalid_or_unknown": len(bad), "platform": platform})
+
+    rng = _random.Random(3)
+
+    # 1: single-key CAS register, no nemesis (the north-star shape).
+    hs = [random_valid_history(rng, "register", n_ops=sz(1000, 50),
+                               n_procs=5, crash_p=0.05)
+          for _ in range(sz(1000, 8))]
+    check_histories(hs[:8], CasRegister(), algorithm="jax",
+                    n_configs=128)  # warm-up compile
+    timed("1: register 1000x1k", CasRegister(), hs)
+
+    # 2: counter workload, no nemesis.
+    hs = [random_valid_history(rng, "counter", n_ops=sz(1000, 50),
+                               n_procs=5, crash_p=0.05)
+          for _ in range(sz(1000, 8))]
+    check_histories(hs[:8], Counter(), algorithm="jax", n_configs=128)
+    timed("2: counter 1000x1k", Counter(), hs)
+
+    # 3: CAS register + partition nemesis, 512 RECORDED histories — run a
+    # real local cluster until ≥512 keys are touched, then reload the
+    # store and batch-verify (checker/recorded.py path).
+    t0 = time.perf_counter()
+    run_dir = _record_real_run(min_keys=sz(512, 16),
+                               time_limit=max(8.0, 90.0 * scale))
+    record_dt = time.perf_counter() - t0
+    from jepsen_jgroups_raft_tpu.checker.recorded import check_recorded
+    t0 = time.perf_counter()
+    summary = check_recorded([run_dir], algorithm="jax")
+    dt = time.perf_counter() - t0
+    emit({"config": "3: recorded 512-key register+partition",
+          "histories": summary["histories"],
+          "record_time_s": round(record_dt, 1),
+          "time_s": round(dt, 3),
+          "histories_per_sec": round(summary["histories"] / dt, 2),
+          "invalid_or_unknown": summary["n-invalid"] + summary["n-unknown"],
+          "platform": platform})
+
+    # 4: independent multi-key, 10k ops per history.
+    hs = [random_valid_history(rng, "register", n_ops=sz(10_000, 500),
+                               n_procs=5, crash_p=0.02)
+          for _ in range(sz(16, 2))]
+    timed("4: independent 16x10k", CasRegister(), hs)
+
+    # 5: long-history stress — one 100k-op register history.
+    h = random_valid_history(rng, "register", n_ops=sz(100_000, 2000),
+                             n_procs=5, crash_p=0.01)
+    timed("5: single 100k-op history", CasRegister(), [h])
+
+
+def _record_real_run(min_keys: int, time_limit: float = 90.0):
+    """Drive a real native cluster (multi-register + partition nemesis)
+    long enough to touch `min_keys` keys; return the store dir."""
+    import tempfile
+
+    from jepsen_jgroups_raft_tpu.core.compose import compose_test
+    from jepsen_jgroups_raft_tpu.core.runner import run_test
+    from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
+                                                      LocalRaftDB)
+
+    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    tmp = tempfile.mkdtemp(prefix="bench-recorded-")
+    cluster = LocalCluster(nodes, sm="map", workdir=tmp + "/sut",
+                           election_ms=150, heartbeat_ms=50,
+                           repl_timeout_ms=3000)
+    opts = {
+        "name": "bench-recorded", "nodes": nodes,
+        "workload": "multi-register", "nemesis": "partition",
+        "conn_factory": cluster.conn_factory(),
+        "rate": 300.0, "interval": 5.0,
+        # ~min_keys keys at ops_per_key ops each, with slack for the
+        # nemesis window; concurrency 10 = 2n like the reference default.
+        "time_limit": time_limit, "quiesce": 1.0, "operation_timeout": 3.0,
+        "concurrency": 10, "ops_per_key": 16,
+        "total_ops": min_keys * 16 + 500,
+        "store_root": tmp + "/store",
+    }
+    test = compose_test(opts, db=LocalRaftDB(cluster, seed=9),
+                        net=BlockNet(cluster), seed=9)
+    try:
+        test = run_test(test)
+    finally:
+        cluster.shutdown()
+    return test["store_dir"]
+
+
 def main() -> None:
+    if "--suite" in sys.argv:
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
+                os.environ.get("JGRAFT_BENCH_PLATFORM") == "cpu":
+            pin_cpu()
+        run_suite()
+        return
     n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
     n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
 

@@ -184,6 +184,32 @@ def cmd_serve(args) -> int:
     return serve(args.store, host=args.host, port=args.port)
 
 
+def cmd_check(args) -> int:
+    """Re-verify recorded runs: store → load → per-key split → one
+    on-device batch (BASELINE config #3's shape). Accepts run dirs or
+    store roots (every run dir beneath them)."""
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    run_dirs = []
+    for p in args.paths:
+        p = Path(p)
+        if (p / "history.jsonl").exists():
+            run_dirs.append(p)
+        else:
+            run_dirs.extend(sorted(
+                d.parent for d in p.glob("**/history.jsonl")
+                if not d.parent.name == "latest"))
+    if not run_dirs:
+        print("no run dirs (history.jsonl) found", file=sys.stderr)
+        return 2
+    from .checker.recorded import check_recorded
+    summary = check_recorded(run_dirs, workload=args.workload,
+                             algorithm=args.algorithm)
+    print(json.dumps(summary, indent=2, default=str))
+    return 0 if summary["valid?"] is True else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="jepsen_jgroups_raft_tpu",
@@ -197,6 +223,16 @@ def main(argv=None) -> int:
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--port", type=int, default=8080)
     s.set_defaults(fn=cmd_serve)
+    c = sub.add_parser("check",
+                       help="re-verify recorded runs as one device batch")
+    c.add_argument("paths", nargs="+",
+                   help="run dirs or store roots to load")
+    c.add_argument("--workload", default=None, choices=sorted(WORKLOADS),
+                   help="override the workload recorded in test.json")
+    c.add_argument("--algorithm", default="auto",
+                   choices=["auto", "jax", "cpu", "dfs", "race"])
+    c.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    c.set_defaults(fn=cmd_check)
     args = ap.parse_args(argv)
     return args.fn(args)
 

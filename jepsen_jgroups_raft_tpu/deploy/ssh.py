@@ -37,48 +37,58 @@ PEER_PORT = 9100
 CHAIN = "JGRAFT_NEMESIS"          # dedicated iptables chain
 
 
+def _paths(remote_dir: str):
+    """(bin, log, pid) under a remote install dir — parameterized so a
+    test tier can point nodes at a scratch dir instead of /opt/raft."""
+    return (f"{remote_dir}/raft_server", f"{remote_dir}/server.log",
+            f"{remote_dir}/server.pid")
+
+
 # ---------------------------------------------------------------- commands
 # Pure builders: each returns a shell line to run ON THE NODE.
 
 def start_daemon_cmd(name: str, members_arg: str, sm: str,
                      election_ms: int, heartbeat_ms: int,
-                     repl_timeout_ms: int) -> str:
+                     repl_timeout_ms: int,
+                     remote_dir: str = REMOTE_DIR) -> str:
     """Daemonize with nohup + pid file + log redirect (start-daemon!
     analogue). Idempotent: refuses if the pid file points at a live
     process (server.clj:143-146)."""
+    rbin, rlog, rpid = _paths(remote_dir)
     args = " ".join(shlex.quote(a) for a in [
-        REMOTE_BIN, "--name", name, "--members", members_arg, "--sm", sm,
-        "--log-dir", f"{REMOTE_DIR}/raftlog",
+        rbin, "--name", name, "--members", members_arg, "--sm", sm,
+        "--log-dir", f"{remote_dir}/raftlog",
         "--election-ms", str(election_ms),
         "--heartbeat-ms", str(heartbeat_ms),
         "--repl-timeout-ms", str(repl_timeout_ms)])
-    return (f"mkdir -p {REMOTE_DIR}/raftlog; "
-            f"if [ -f {REMOTE_PID} ] && kill -0 $(cat {REMOTE_PID}) "
+    return (f"mkdir -p {remote_dir}/raftlog; "
+            f"if [ -f {rpid} ] && kill -0 $(cat {rpid}) "
             f"2>/dev/null; then echo already-running; else "
-            f"nohup {args} >> {REMOTE_LOG} 2>&1 & echo $! > {REMOTE_PID}; "
+            f"nohup {args} >> {rlog} 2>&1 & echo $! > {rpid}; "
             f"echo started; fi")
 
 
-def kill_cmd() -> str:
+def kill_cmd(remote_dir: str = REMOTE_DIR) -> str:
     """SIGKILL until gone (definitely-stop! loop, server.clj:119-127)."""
-    return (f"if [ -f {REMOTE_PID} ]; then "
+    rpid = _paths(remote_dir)[2]
+    return (f"if [ -f {rpid} ]; then "
             f"for i in $(seq 1 50); do "
-            f"kill -0 $(cat {REMOTE_PID}) 2>/dev/null || break; "
-            f"kill -9 $(cat {REMOTE_PID}) 2>/dev/null; sleep 0.1; done; "
-            f"rm -f {REMOTE_PID}; fi; echo killed")
+            f"kill -0 $(cat {rpid}) 2>/dev/null || break; "
+            f"kill -9 $(cat {rpid}) 2>/dev/null; sleep 0.1; done; "
+            f"rm -f {rpid}; fi; echo killed")
 
 
-def pause_cmd() -> str:
-    return f"kill -STOP $(cat {REMOTE_PID}); echo paused"
+def pause_cmd(remote_dir: str = REMOTE_DIR) -> str:
+    return f"kill -STOP $(cat {_paths(remote_dir)[2]}); echo paused"
 
 
-def resume_cmd() -> str:
-    return f"kill -CONT $(cat {REMOTE_PID}); echo resumed"
+def resume_cmd(remote_dir: str = REMOTE_DIR) -> str:
+    return f"kill -CONT $(cat {_paths(remote_dir)[2]}); echo resumed"
 
 
-def teardown_cmd() -> str:
+def teardown_cmd(remote_dir: str = REMOTE_DIR) -> str:
     """Remove binary + logs (server.clj:175-179)."""
-    return f"rm -rf {REMOTE_DIR}; echo cleaned"
+    return f"rm -rf {remote_dir}; echo cleaned"
 
 
 def iptables_setup_cmds() -> List[str]:
@@ -168,10 +178,16 @@ class RemoteRaftCluster:
                  ssh_user: str = "root", ssh_key: Optional[str] = None,
                  election_ms: int = 300, heartbeat_ms: int = 100,
                  repl_timeout_ms: int = 30000,
-                 log_download_dir: Optional[str] = None):
+                 log_download_dir: Optional[str] = None,
+                 remote_dir: str = REMOTE_DIR,
+                 client_port: int = CLIENT_PORT,
+                 peer_port: int = PEER_PORT):
         ensure_built()
         self.nodes = list(nodes)
         self.sm = sm
+        self.remote_dir = remote_dir
+        self.client_port = client_port
+        self.peer_port = peer_port
         self.election_ms = election_ms
         self.heartbeat_ms = heartbeat_ms
         self.repl_timeout_ms = repl_timeout_ms
@@ -187,13 +203,13 @@ class RemoteRaftCluster:
         return self.remotes[node]
 
     def spec(self, name: str) -> str:
-        return f"{name}={name}:{CLIENT_PORT}:{PEER_PORT}"
+        return f"{name}={name}:{self.client_port}:{self.peer_port}"
 
     def members_arg(self, names: Iterable[str]) -> str:
         return ",".join(self.spec(n) for n in sorted(set(names)))
 
     def resolve(self, name: str) -> Tuple[str, int]:
-        return name, CLIENT_PORT
+        return name, self.client_port
 
     def install(self, node: str) -> None:
         """Upload the server binary (install-server!, server.clj:60-65).
@@ -202,9 +218,10 @@ class RemoteRaftCluster:
         if node in self.installed:
             return
         r = self.remote(node)
-        r.exec(f"mkdir -p {REMOTE_DIR}")
-        r.upload(str(SERVER_BIN), REMOTE_BIN)
-        r.exec(f"chmod +x {REMOTE_BIN}")
+        rbin = _paths(self.remote_dir)[0]
+        r.exec(f"mkdir -p {self.remote_dir}")
+        r.upload(str(SERVER_BIN), rbin)
+        r.exec(f"chmod +x {rbin}")
         for cmd in iptables_setup_cmds():
             r.exec(cmd, check=False)
         self.installed.add(node)
@@ -213,22 +230,23 @@ class RemoteRaftCluster:
         self.install(name)
         out = self.remote(name).exec(start_daemon_cmd(
             name, self.members_arg(set(members) | {name}), self.sm,
-            self.election_ms, self.heartbeat_ms, self.repl_timeout_ms))
+            self.election_ms, self.heartbeat_ms, self.repl_timeout_ms,
+            remote_dir=self.remote_dir))
         return out.stdout.strip()
 
     def kill_node(self, name: str) -> None:
-        self.remote(name).exec(kill_cmd(), check=False)
+        self.remote(name).exec(kill_cmd(self.remote_dir), check=False)
 
     def pause_node(self, name: str) -> None:
-        self.remote(name).exec(pause_cmd(), check=False)
+        self.remote(name).exec(pause_cmd(self.remote_dir), check=False)
 
     def resume_node(self, name: str) -> None:
-        self.remote(name).exec(resume_cmd(), check=False)
+        self.remote(name).exec(resume_cmd(self.remote_dir), check=False)
 
     def probe(self, name: str, timeout: float = 2.0):
         conn = None
         try:
-            conn = NativeConn(name, CLIENT_PORT, timeout)
+            conn = NativeConn(name, self.client_port, timeout)
             return conn.probe()
         except Exception:
             return None
@@ -237,7 +255,7 @@ class RemoteRaftCluster:
                 conn.close()
 
     def admin(self, name: str, timeout: float = 15.0) -> NativeConn:
-        return NativeConn(name, CLIENT_PORT, timeout)
+        return NativeConn(name, self.client_port, timeout)
 
     def conn_factory(self):
         return make_conn_factory(self.resolve)
@@ -257,11 +275,12 @@ class RemoteRaftDB(RaftDB):
     def setup(self, test, node):
         super().setup(test, node)
         from .local import wait_for_port
-        wait_for_port(node, CLIENT_PORT, timeout=30.0)
+        wait_for_port(node, self.cluster.client_port, timeout=30.0)
 
     def teardown(self, test, node):
         self.cluster.kill_node(node)
-        self.cluster.remote(node).exec(teardown_cmd(), check=False)
+        self.cluster.remote(node).exec(teardown_cmd(self.cluster.remote_dir),
+                                       check=False)
         self.cluster.installed.discard(node)
 
     def log_files(self, test, node):
@@ -270,7 +289,8 @@ class RemoteRaftDB(RaftDB):
         root = Path(test.get("store_dir") or self.cluster.log_download_dir)
         dest = root / "node-logs" / f"{node}-server.log"
         dest.parent.mkdir(parents=True, exist_ok=True)
-        if self.cluster.remote(node).download(REMOTE_LOG, str(dest)):
+        rlog = _paths(self.cluster.remote_dir)[1]
+        if self.cluster.remote(node).download(rlog, str(dest)):
             return [str(dest)]
         return []
 

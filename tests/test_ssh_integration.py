@@ -1,0 +1,135 @@
+"""SSH tier integration: the generated remote commands actually RUN.
+
+Round-1 gap: deploy/ssh.py was unit-tested as pure command construction
+only. This test executes the full lifecycle — install (scp upload +
+chmod), daemonized start (nohup + pidfile), port await, client traffic,
+SIGSTOP pause/resume, loop-kill, crash-recovery restart, log download,
+teardown — through SshRemote against THIS host, with `ssh`/`scp` shimmed
+to local execution (the shim strips ssh/scp option flags and runs the
+command / copies the file). Everything except the network hop is real:
+real shell parsing of the generated lines, real nohup daemon, real pid
+files, real SIGKILL loops.
+
+The remaining real-network path (actual sshd + iptables partitions) needs
+the provision/ docker topology — see test_provisioning.py, which is gated
+on a docker-capable host.
+"""
+
+import os
+import stat
+import time
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.deploy.local import wait_for_port
+from jepsen_jgroups_raft_tpu.deploy.ssh import RemoteRaftCluster, RemoteRaftDB
+from jepsen_jgroups_raft_tpu.native.client import NativeRsmConn
+
+SSH_SHIM = """#!/usr/bin/env python3
+import subprocess, sys
+args, i = [], 1
+while i < len(sys.argv):
+    if sys.argv[i] in ("-o", "-i"):
+        i += 2
+    else:
+        args.append(sys.argv[i]); i += 1
+# args[0] = user@host, args[1] = the remote shell line
+sys.exit(subprocess.call(["bash", "-c", args[1]]))
+"""
+
+SCP_SHIM = """#!/usr/bin/env python3
+import re, shutil, sys
+args, i = [], 1
+while i < len(sys.argv):
+    if sys.argv[i] in ("-o", "-i"):
+        i += 2
+    else:
+        args.append(sys.argv[i]); i += 1
+def local(p):
+    return re.sub(r"^[^@/:]+@[^:]+:", "", p)
+shutil.copy(local(args[0]), local(args[1]))
+"""
+
+
+@pytest.fixture
+def shimmed_path(tmp_path, monkeypatch):
+    shim_dir = tmp_path / "shims"
+    shim_dir.mkdir()
+    for name, body in (("ssh", SSH_SHIM), ("scp", SCP_SHIM)):
+        p = shim_dir / name
+        p.write_text(body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{shim_dir}:{os.environ['PATH']}")
+    return shim_dir
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ssh_tier_full_lifecycle_executes(tmp_path, shimmed_path):
+    remote_dir = str(tmp_path / "opt-raft")
+    cluster = RemoteRaftCluster(
+        ["127.0.0.1"], sm="map", remote_dir=remote_dir,
+        client_port=_free_port(), peer_port=_free_port(),
+        election_ms=150, heartbeat_ms=50, repl_timeout_ms=3000,
+        log_download_dir=str(tmp_path / "logs"))
+    node = "127.0.0.1"
+    db = RemoteRaftDB(cluster)
+    test = {"nodes": [node], "members": {node},
+            "store_dir": str(tmp_path / "store")}
+    os.makedirs(test["store_dir"])
+    try:
+        # install + daemonize + await (db/DB setup!)
+        assert db.setup(test, node) is None
+        assert (tmp_path / "opt-raft" / "server.pid").exists()
+        assert cluster.start_node(node, [node]) == "already-running"
+
+        conn = NativeRsmConn(*cluster.resolve(node), timeout=3.0)
+        try:
+            conn.put(1, 42)
+            assert conn.get(1) == 42
+
+            # pause → unreachable; resume → answers again (db/Pause)
+            db.pause(test, node)
+            with pytest.raises(Exception):
+                NativeRsmConn(*cluster.resolve(node), timeout=0.6).get(1)
+            db.resume(test, node)
+            assert conn.get(1) == 42
+        finally:
+            conn.close()
+
+        # loop-kill (db/Kill) then restart: crash-RECOVERY — the value
+        # must survive via the fsync'd raft log in remote_dir/raftlog.
+        db.kill(test, node)
+        time.sleep(0.2)
+        assert cluster.start_node(node, [node]) == "started"
+        wait_for_port(*cluster.resolve(node), timeout=15.0)
+        conn = NativeRsmConn(*cluster.resolve(node), timeout=3.0)
+        try:
+            deadline = time.monotonic() + 10.0
+            val = None
+            while time.monotonic() < deadline:
+                try:
+                    val = conn.get(1)
+                    break
+                except Exception:
+                    time.sleep(0.2)  # election in progress
+            assert val == 42
+        finally:
+            conn.close()
+
+        # log download (db/LogFiles) into the store dir
+        files = db.log_files(test, node)
+        assert files and os.path.getsize(files[0]) > 0
+
+        # teardown removes the install dir
+        db.teardown(test, node)
+        assert not (tmp_path / "opt-raft").exists()
+    finally:
+        cluster.shutdown()
