@@ -89,8 +89,10 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         for _ in range(n_histories)
     ]
 
+    from jepsen_jgroups_raft_tpu.ops.linear_scan import bucket_slots
+
     encs = [encode_history(h, model) for h in histories]
-    n_slots = max(8, max(e.n_slots for e in encs))
+    n_slots = bucket_slots(max(e.n_slots for e in encs))
     mesh = make_mesh()
 
     def run():
@@ -98,7 +100,7 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         batch = pack_batch(encs)
         t1 = time.perf_counter()
         ok, overflow, n_valid, n_unknown = check_batch_sharded(
-            model, batch["events"], mesh, n_configs=128, n_slots=n_slots
+            model, batch["events"], mesh, n_slots=n_slots
         )
         t2 = time.perf_counter()
         return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown

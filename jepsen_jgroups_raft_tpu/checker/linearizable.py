@@ -69,9 +69,9 @@ def check_histories(
     The batch is the unit of TPU work: all histories are packed, padded to a
     common event length, and verified in one vmapped kernel launch.
     n_configs/n_slots default to auto: the concurrency window is sized to
-    the batch's real maximum (bucketed to SLOT_BUCKETS: 8/16/31/63/127) —
-    per-event closure work scales with C×W, so a snug window is a direct
-    kernel-speed win.
+    the batch's real maximum (exact ≤16 slots, else bucketed to
+    SLOT_BUCKETS 31/63/95/127) — per-event closure work scales with C×W,
+    so a snug window is a direct kernel-speed win.
     """
 
     encs = [encode_history(h, model) for h in histories]

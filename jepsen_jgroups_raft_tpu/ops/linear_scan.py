@@ -13,7 +13,7 @@ a fixed-shape scan that XLA compiles onto the TPU vector unit:
     expand every configuration by every open un-linearized slot — a single
     branch-free [C, W] evaluation of the model's vectorized step — then
     deduplicate by a multi-key `lax.sort` and compact, repeating under
-    `lax.while_loop` until the frontier stops growing.
+    `lax.while_loop` until no novel configuration appears.
   * Dedup-by-sort is the memoization: it plays the role of knossos'
     visited-configuration hash set, but as a data-parallel primitive with
     no hashing and no false positives (soundness note in SURVEY.md §7.4.2).
@@ -31,7 +31,23 @@ timeout-polluted histories hold slots open indefinitely — exactly the
 regime that must stay on-device. K is chosen so the last word always has
 at least one unused top bit, keeping the all-ones empty-entry sentinel
 distinct from every reachable configuration (soundness: a fully-set mask
-can never be silently dropped as "empty").
+can never be silently dropped as "empty"), and letting the compaction
+sort key on the last word alone to order sentinels after live entries.
+
+Two measured-on-hardware design rules (round 2; each is >2× on v5e):
+
+  * **No scatter, no gather.** TPU scatters serialize; a cumsum+scatter
+    compaction made the whole kernel 4.5× slower than the pure-sort
+    alternative used here: mark duplicates/sentinels, overwrite them with
+    the sentinel, sort again, and slice the first C rows. Two sorts beat
+    one scatter.
+  * **Novelty by tag bit, not by count.** Each dedup round sorts a 0/1
+    provenance tag behind the (mask, state) keys — parents 0, fresh
+    candidates 1 — so "did this round reach a new configuration" is
+    `any(kept & tag==1)`, exact even when the frontier holds duplicates.
+    That lets the post-FORCE slot-bit recycling skip its own re-dedup
+    entirely (duplicate parents merge for free at the next closure), which
+    removed a per-event C-element sort that cost ~25% of the kernel.
 
 Why closure only at FORCE events is sound: between two completions no
 real-time precedence edge can appear (all open ops are mutually concurrent),
@@ -53,10 +69,15 @@ from ..history.packing import EV_FORCE, EV_OPEN
 #: masks are arbitrary-precision.
 MAX_SLOTS = 127
 
-#: Window sizes worth compiling: snug sizes for typical histories, then
-#: word-boundary maxima (32k-1 slots per k words). check_histories buckets
-#: each batch's real window up to the next rung.
-SLOT_BUCKETS = (8, 16, 31, 63, 127)
+#: Windows ≤ SLOT_EXACT_MAX compile at their exact size — per-event closure
+#: work is linear in C×W, and typical windows (≤ n_procs, e.g. 5) are far
+#: below the smallest useful bucket, so snug shapes are a direct ~2× win.
+#: Wider windows quantize to SLOT_BUCKETS to bound recompilation.
+SLOT_EXACT_MAX = 16
+
+#: Bucket rungs above SLOT_EXACT_MAX: word-boundary maxima (32k-1 slots for
+#: k mask words). check_histories buckets each batch's real window up.
+SLOT_BUCKETS = (31, 63, 95, 127)
 
 DEFAULT_N_CONFIGS = 256
 
@@ -67,29 +88,45 @@ DEFAULT_N_CONFIGS = 256
 _SENT = np.uint32(0xFFFFFFFF)
 
 
-def _dedup_compact(masks, states, n_configs):
-    """Sort (mask-words…, state) tuples, drop duplicates & sentinels,
-    compact the first n_configs into a fresh frontier. masks: [N, K].
-    Returns (masks', states', count, overflowed)."""
+def _dedup_compact(masks, states, tags, n_configs):
+    """Deduplicate (mask-words…, state) tuples and compact the survivors
+    into a fresh C-row frontier, scatter-free (see module docstring).
+
+    masks: [N, K] uint32, states: [N] int32, tags: [N] int32 (0 = entry was
+    already in the frontier, 1 = fresh candidate). Returns
+    (masks' [C,K], states' [C], count, overflowed, grew) where `count` is
+    the exact number of distinct live configurations and `grew` is whether
+    any kept entry is tagged fresh — the closure's exact fixpoint test.
+    """
     K = masks.shape[1]
-    cols = tuple(masks[:, j] for j in range(K)) + (states,)
-    sorted_cols = lax.sort(cols, num_keys=K + 1)
-    sm = jnp.stack(sorted_cols[:-1], axis=1)  # [N, K]
-    ss = sorted_cols[-1]
-    diff = jnp.any(sm[1:] != sm[:-1], axis=1) | (ss[1:] != ss[:-1])
-    first = jnp.concatenate([jnp.array([True]), diff])
+    C = n_configs
+    # Sort with the tag as the last key: among equal (mask, state) rows the
+    # parent (tag 0) sorts first, so a candidate equal to an existing
+    # configuration is always marked duplicate, never counted as novel.
+    cols = tuple(masks[:, j] for j in range(K)) + (states, tags)
+    sorted_cols = lax.sort(cols, num_keys=K + 2)
+    sm = jnp.stack(sorted_cols[:K], axis=1)  # [N, K]
+    ss = sorted_cols[K]
+    st = sorted_cols[K + 1]
+    dup = jnp.concatenate([
+        jnp.array([False]),
+        jnp.all(sm[1:] == sm[:-1], axis=1) & (ss[1:] == ss[:-1]),
+    ])
     # Empty entries are all-ones; the last word alone suffices as the test
     # (its top bit is never set in a reachable config, by choice of K).
-    keep = first & (sm[:, K - 1] != _SENT)
-    pos = jnp.cumsum(keep) - 1
+    keep = ~dup & (sm[:, K - 1] != _SENT)
     count = jnp.sum(keep)
-    overflow = count > n_configs
-    idx = jnp.where(keep & (pos < n_configs), pos, n_configs)
-    out_m = jnp.full((n_configs, K), _SENT,
-                     dtype=jnp.uint32).at[idx].set(sm, mode="drop")
-    out_s = jnp.zeros((n_configs,), dtype=jnp.int32).at[idx].set(
-        ss, mode="drop")
-    return out_m, out_s, jnp.minimum(count, n_configs), overflow
+    grew = jnp.any(keep & (st == 1))
+    # Compaction: blank the dropped rows to the sentinel and re-sort keyed
+    # on the last mask word (live < sentinel there, by construction), so
+    # every kept row lands in the first `count` slots — no scatter.
+    m2 = jnp.where(keep[:, None], sm, _SENT)
+    s2 = jnp.where(keep, ss, 0)
+    cols2 = (m2[:, K - 1],) + tuple(m2[:, j] for j in range(K - 1)) + (s2,)
+    sorted2 = lax.sort(cols2, num_keys=1)
+    out_m = jnp.stack(tuple(sorted2[1:K]) + (sorted2[0],), axis=1)[:C]
+    out_s = sorted2[K][:C]
+    return out_m, out_s, jnp.minimum(count, C), count > C, grew
 
 
 def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
@@ -113,8 +150,10 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         (np.arange(K)[None, :] == slot_word[:, None]), dtype=jnp.uint32)
     set_bits = word_onehot * slot_bit[:, None]  # [W, K]
     sent_row = jnp.full((K,), _SENT, dtype=jnp.uint32)
+    parent_tags = jnp.zeros((C,), dtype=jnp.int32)
+    cand_tags = jnp.ones((C * W,), dtype=jnp.int32)
 
-    def expand_once(masks, states, count, overflow, slot_f, slot_a, slot_b,
+    def expand_once(masks, states, overflow, slot_f, slot_a, slot_b,
                     slot_open):
         live = masks[:, K - 1] != _SENT  # [C]
         s = states[:, None]
@@ -128,32 +167,34 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         cand_s = jnp.where(good, ns, 0).astype(jnp.int32)
         all_m = jnp.concatenate([masks, cand_m.reshape(-1, K)])
         all_s = jnp.concatenate([states, cand_s.reshape(-1)])
-        nm, nstates, ncount, of = _dedup_compact(all_m, all_s, C)
-        return nm, nstates, ncount, overflow | of
+        all_t = jnp.concatenate([parent_tags, cand_tags])
+        nm, nstates, _, of, grew = _dedup_compact(all_m, all_s, all_t, C)
+        return nm, nstates, grew, overflow | of
 
-    def closure(masks, states, count, overflow, slot_f, slot_a, slot_b,
+    def closure(masks, states, overflow, slot_f, slot_a, slot_b,
                 slot_open, active):
-        # Fixed point: each round adds ≥1 bit to some mask or stops, so at
-        # most W productive rounds; `active` short-circuits non-FORCE events
-        # (the while body never runs for them).
+        # Fixed point: iterate while a round reaches a novel configuration
+        # (the tag test — exact even with duplicate parents, see module
+        # docstring). Each productive round sets ≥1 more mask bit, so ≤W
+        # rounds; `active` short-circuits non-FORCE events (the while body
+        # never runs for them).
         def cond(c):
             return c[0]
 
         def body(c):
-            _, it, masks, states, count, overflow = c
-            nm, ns, ncount, nof = expand_once(masks, states, count, overflow,
-                                              slot_f, slot_a, slot_b,
-                                              slot_open)
-            grew = ncount > count
-            return (grew & (it < W), it + 1, nm, ns, ncount, nof)
+            _, it, masks, states, overflow = c
+            nm, ns, grew, nof = expand_once(masks, states, overflow,
+                                            slot_f, slot_a, slot_b,
+                                            slot_open)
+            return (grew & (it < W), it + 1, nm, ns, nof)
 
-        _, _, masks, states, count, overflow = lax.while_loop(
-            cond, body, (active, jnp.int32(0), masks, states, count, overflow)
+        _, _, masks, states, overflow = lax.while_loop(
+            cond, body, (active, jnp.int32(0), masks, states, overflow)
         )
-        return masks, states, count, overflow
+        return masks, states, overflow
 
     def scan_step(carry, ev):
-        masks, states, count, slot_f, slot_a, slot_b, slot_open, ok, overflow = carry
+        masks, states, slot_f, slot_a, slot_b, slot_open, ok, overflow = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
@@ -165,8 +206,8 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         slot_b = jnp.where(upd, b, slot_b)
         slot_open = jnp.where(upd, True, slot_open)
 
-        masks, states, count, overflow = closure(
-            masks, states, count, overflow, slot_f, slot_a, slot_b,
+        masks, states, overflow = closure(
+            masks, states, overflow, slot_f, slot_a, slot_b,
             slot_open, is_force)
 
         # FORCE: survivors have the slot's bit; then the bit is recycled.
@@ -185,11 +226,12 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         alive = jnp.any(cleared_m[:, K - 1] != _SENT)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~(onehot & is_force)
-        # Clearing the recycled bit can merge configurations; re-dedup so the
-        # next closure's grew-by-count fixpoint test stays exact. (Idempotent
-        # and cheap for non-FORCE events: one C-element sort.)
-        masks, states, count, _ = _dedup_compact(cleared_m, states, C)
-        return (masks, states, count, slot_f, slot_a, slot_b, slot_open,
+        # Clearing the recycled bit can merge configurations into
+        # duplicates; they stay in place and merge for free at the next
+        # closure's dedup (the tag-based fixpoint test is exact under
+        # duplicates, so no per-event re-dedup is needed — measured ~25%
+        # of kernel time when it was).
+        return (cleared_m, states, slot_f, slot_a, slot_b, slot_open,
                 ok, overflow), None
 
     def check(events):
@@ -197,13 +239,13 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
             jnp.zeros((K,), dtype=jnp.uint32))
         states = jnp.zeros((C,), dtype=jnp.int32).at[0].set(init_state)
         carry = (
-            masks, states, jnp.int32(1),
+            masks, states,
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False),
         )
         carry, _ = lax.scan(scan_step, carry, events)
-        ok, overflow = carry[7], carry[8]
+        ok, overflow = carry[6], carry[7]
         # An overflowed run may have dropped configurations: a "False" can
         # be a false negative, so report unknown instead (caller escalates).
         return ok, overflow
@@ -212,7 +254,10 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
 
 
 def bucket_slots(n: int) -> int:
-    """Smallest SLOT_BUCKETS rung ≥ n (kernel-shape quantization)."""
+    """Kernel window for a real window of n slots: exact when small (snug
+    shapes are a ~2× kernel win), else the smallest SLOT_BUCKETS rung ≥ n."""
+    if n <= SLOT_EXACT_MAX:
+        return max(n, 1)
     for b in SLOT_BUCKETS:
         if n <= b:
             return b
