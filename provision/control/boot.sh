@@ -1,0 +1,26 @@
+#!/bin/sh
+# Control boot: install the SSH identity, wait for every worker to
+# register in the shared volume and resolve in DNS, write /root/nodes
+# (the --nodes-file input, reference doc/running.md:88), then hold the
+# container open for `docker compose exec`.
+set -eu
+
+EXPECTED="${JGRAFT_EXPECTED_NODES:-3}"
+
+mkdir -p /root/.ssh && chmod 700 /root/.ssh
+cp /root/.secrets/id_ed25519 /root/.ssh/id_ed25519
+chmod 600 /root/.ssh/id_ed25519
+
+echo "waiting for ${EXPECTED} workers to register..."
+while [ "$(sort -u /var/jgraft/shared/nodes 2>/dev/null | wc -l)" -lt "$EXPECTED" ]; do
+    sleep 1
+done
+sort -u /var/jgraft/shared/nodes > /root/nodes
+
+while read -r node; do
+    until getent hosts "$node" > /dev/null; do sleep 1; done
+done < /root/nodes
+
+echo "cluster ready:"; cat /root/nodes
+echo "run: docker compose exec control bash"
+exec tail -f /dev/null

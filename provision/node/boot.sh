@@ -1,0 +1,20 @@
+#!/bin/sh
+# Worker boot: install the injected key, register this node's hostname in
+# the shared volume so control can discover the cluster (the reference's
+# node-discovery dance, reference bin/docker/node/setup-jepsen.sh:7-16),
+# then run sshd in the foreground.
+set -eu
+
+# /run is a tmpfs mount (compose), which hides the image's /run/sshd —
+# sshd refuses to start without its privilege-separation dir.
+mkdir -p /run/sshd
+
+cp /run/secrets/authorized_keys /root/.ssh/authorized_keys
+chmod 600 /root/.ssh/authorized_keys
+
+mkdir -p /var/jgraft/shared
+if ! grep -qx "$(hostname)" /var/jgraft/shared/nodes 2>/dev/null; then
+    hostname >> /var/jgraft/shared/nodes
+fi
+
+exec /usr/sbin/sshd -D -e

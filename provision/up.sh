@@ -1,0 +1,34 @@
+#!/bin/sh
+# Bring up the containerized SSH-tier environment (the reference's bin/up
+# flow, reference bin/up:32-84, simplified: secrets are generated files,
+# the repo is bind-mounted, and compose does the rest).
+#
+#   ./up.sh            build + start, wait until control reports ready
+#   ./up.sh down       stop and remove everything including volumes
+set -eu
+cd "$(dirname "$0")"
+
+if [ "${1:-}" = "down" ]; then
+    docker compose down -v --remove-orphans
+    exit 0
+fi
+
+mkdir -p .secrets
+if [ ! -f .secrets/id_ed25519 ]; then
+    ssh-keygen -t ed25519 -N "" -q -f .secrets/id_ed25519
+fi
+
+docker compose up --build -d
+
+echo "waiting for control to finish node discovery..."
+for _ in $(seq 1 120); do
+    if docker compose logs control 2>/dev/null | grep -q "cluster ready"; then
+        docker compose exec -T control cat /root/nodes
+        echo "up. next: docker compose exec control bash"
+        exit 0
+    fi
+    sleep 1
+done
+echo "control never became ready; logs:" >&2
+docker compose logs >&2
+exit 1
