@@ -89,18 +89,22 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         for _ in range(n_histories)
     ]
 
+    from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plan
     from jepsen_jgroups_raft_tpu.ops.linear_scan import bucket_slots
 
     encs = [encode_history(h, model) for h in histories]
     n_slots = bucket_slots(max(e.n_slots for e in encs))
     mesh = make_mesh()
+    # Dense-bitset kernel when the workload's value domain allows it (the
+    # north-star register shape does); sort-kernel ladder otherwise.
+    plan = dense_plan(model, encs)
 
     def run():
         t0 = time.perf_counter()
         batch = pack_batch(encs)
         t1 = time.perf_counter()
         ok, overflow, n_valid, n_unknown = check_batch_sharded(
-            model, batch["events"], mesh, n_slots=n_slots
+            model, batch["events"], mesh, n_slots=n_slots, dense=plan
         )
         t2 = time.perf_counter()
         return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
@@ -124,7 +128,8 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "n_histories": n_histories,
         "n_ops": n_ops,
         "n_procs": n_procs,
-        "concurrency_window": n_slots,
+        "kernel": "dense" if plan is not None else "sort",
+        "concurrency_window": plan[0] if plan is not None else n_slots,
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),
@@ -134,10 +139,13 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     })
 
 
-def run_suite() -> None:
+def run_suite(platform_note: str) -> None:
     """BASELINE.json's five configs at full size, one JSON line each.
     Operator-invoked (`python bench.py --suite`); the driver's default
-    invocation stays the single north-star line."""
+    invocation stays the single north-star line. The platform was already
+    resolved by `resolve_platform` (the caller) — touching jax.devices()
+    here without that guard would hang when the TPU tunnel is down (the
+    round-1 rc=124 mode; it bit the suite path too in round 2)."""
     import random as _random
 
     import jax
@@ -148,6 +156,7 @@ def run_suite() -> None:
     from jepsen_jgroups_raft_tpu.models.register import CasRegister
 
     platform = jax.devices()[0].platform
+    emit({"suite_platform": platform, "note": platform_note})
     # JGRAFT_SUITE_SCALE in (0,1] shrinks every config proportionally —
     # smoke-testing the suite plumbing without the full-size wall clock.
     scale = float(os.environ.get("JGRAFT_SUITE_SCALE", "1"))
@@ -250,46 +259,47 @@ def _record_real_run(min_keys: int, time_limit: float = 90.0):
     return test["store_dir"]
 
 
-def main() -> None:
-    if "--suite" in sys.argv:
-        if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
-                os.environ.get("JGRAFT_BENCH_PLATFORM") == "cpu":
-            pin_cpu()
-        run_suite()
-        return
-    n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
-    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
-
+def resolve_platform() -> str:
+    """Decide and PIN the jax platform before any backend init, hang-proof:
+    explicit override > env pin > subprocess-probed default (a wedged TPU
+    tunnel makes in-process default init block forever — round-1 rc=124).
+    Returns a human-readable note for the artifact."""
     if os.environ.get("JGRAFT_BENCH_PLATFORM"):  # explicit override
         platform = os.environ["JGRAFT_BENCH_PLATFORM"]
         if platform == "cpu":
             pin_cpu()
         else:
             # Actually pin the named platform — otherwise the default
-            # backend would initialize instead (and can hang: round-1
-            # rc=124 had no timeout on this path).
+            # backend would initialize instead (and can hang).
             os.environ["JAX_PLATFORMS"] = platform
             import jax
 
             jax.config.update("jax_platforms", platform)
-        note = f"forced:{platform}"
-    elif os.environ.get("JAX_PLATFORMS"):
+        return f"forced:{platform}"
+    if os.environ.get("JAX_PLATFORMS"):
         # Platform already pinned by the environment: no probe needed (the
         # probe exists only to detect a hung default-TPU init, and on the
         # healthy path it would pay backend init twice).
         platform = os.environ["JAX_PLATFORMS"].split(",")[0]
         if platform == "cpu":
             pin_cpu()
-        note = f"{platform} (env-pinned)"
-    else:
-        platform = probe_default_platform()
-        if platform is None or platform == "cpu":
-            pin_cpu()
-            note = ("cpu (default backend probe failed/timed out — TPU "
-                    "unreachable, degraded to host CPU)"
-                    if platform is None else "cpu (default backend)")
-        else:
-            note = f"{platform} (default backend)"
+        return f"{platform} (env-pinned)"
+    platform = probe_default_platform()
+    if platform is None or platform == "cpu":
+        pin_cpu()
+        return ("cpu (default backend probe failed/timed out — TPU "
+                "unreachable, degraded to host CPU)"
+                if platform is None else "cpu (default backend)")
+    return f"{platform} (default backend)"
+
+
+def main() -> None:
+    note = resolve_platform()
+    if "--suite" in sys.argv:
+        run_suite(note)
+        return
+    n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
     run_bench(n_histories, n_ops, note)
 
 

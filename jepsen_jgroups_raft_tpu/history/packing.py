@@ -125,6 +125,39 @@ def encode_history(
     )
 
 
+def pad_batch_bucketed(events: np.ndarray, tables=(), floor_b: int = 8,
+                       floor_e: Optional[int] = 32, multiple_b: int = 1):
+    """Pad a packed [B, E, 5] batch (and optional per-history [B, X]
+    tables) to jit-cache-friendly shapes: B to the next power of two ≥
+    floor_b (then up to a multiple of multiple_b, for mesh sharding), E to
+    the next power of two ≥ floor_e (None keeps E). Pad rows are EV_PAD
+    no-ops. Returns (events, tables_list, original_B) — the single home of
+    the padding convention (checker and mesh both route through it)."""
+    B, E = events.shape[0], events.shape[1]
+    B2 = _bucket_pow2(B, floor_b)
+    B2 = ((B2 + multiple_b - 1) // multiple_b) * multiple_b
+    E2 = E if floor_e is None else _bucket_pow2(E, floor_e)
+    if (B2, E2) != (B, E):
+        padded = np.zeros((B2, E2) + events.shape[2:], dtype=events.dtype)
+        padded[:B, :E] = events
+        events = padded
+    out_tables = []
+    for t in tables:
+        if t.shape[0] != B2:
+            tp = np.zeros((B2,) + t.shape[1:], dtype=t.dtype)
+            tp[:B] = t
+            t = tp
+        out_tables.append(t)
+    return events, out_tables, B
+
+
+def _bucket_pow2(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
 def pack_batch(
     encoded: Iterable[EncodedHistory],
     n_events: Optional[int] = None,

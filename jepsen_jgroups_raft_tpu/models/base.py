@@ -79,6 +79,14 @@ class Model:
             return None
         return self._encode(pair)
 
+    def dense_domain(self, events) -> Optional[list]:
+        """Enumerate the reachable state-value domain of a packed history
+        (events [E,5] int32, initial state FIRST), or None when the domain
+        is not small/enumerable. Models that can answer (e.g. a register:
+        initial ∪ written ∪ cas-to values) unlock the dense-bitset kernel
+        (ops/dense_scan.py); the default keeps the general sort kernel."""
+        return None
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         raise NotImplementedError
 

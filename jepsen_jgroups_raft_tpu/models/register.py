@@ -60,6 +60,21 @@ class CasRegister(Model):
         )
         return new_state, legal
 
+    def dense_domain(self, events):
+        """Reachable register values: initial ∪ {a of writes} ∪ {b of cas}
+        (a write sets a; a successful cas sets b; reads keep state). Read
+        expectations outside this set simply never match — the config dies
+        at that read's FORCE, which is the correct verdict."""
+        import numpy as np
+
+        from ..history.packing import EV_OPEN
+
+        opens = events[events[:, 0] == EV_OPEN]
+        vals = {int(self.initial)}
+        vals.update(int(v) for v in opens[opens[:, 2] == WRITE][:, 3])
+        vals.update(int(v) for v in opens[opens[:, 2] == CAS][:, 4])
+        return [int(self.initial)] + sorted(vals - {int(self.initial)})
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         f = pair.f
         forced = pair.ctype == OK

@@ -1,0 +1,208 @@
+"""Dense-bitset frontier kernel: exact linearizability for small domains.
+
+The sort-based kernel (ops/linear_scan.py) represents the frontier as an
+explicit list of (mask, state) configurations and pays a sort-dedup per
+closure round. For the workloads the reference actually runs, that is
+overkill: a CAS register over a handful of values (reference
+workload/register.clj:21-34 draws values from [0,5)) has a *reachable
+state domain* enumerable straight from the history — the initial value
+plus every written / cas-to value. When the domain S and the concurrency
+window W are both small, the entire powerset-of-window × domain fits in a
+**dense boolean frontier F[2^W, S]**: F[m, s] = "some linearization of
+exactly the ops in mask m ends in state s".
+
+This is the on-device visited-*bitset* form of the search (the shape
+BASELINE.json's north star names): dedup is free (a bit can only be set
+once), overflow cannot happen (the array IS the configuration space), and
+every kernel operation is a static reshape, tiny matmul, or elementwise
+op — no sort, no scatter, no gather. Measured ~10× over the sort kernel
+on the north-star shape (W=5, S=6); it is selected automatically by the
+checker whenever a model can enumerate the domain (`Model.dense_domain`)
+and the [2^W, S] cells fit DENSE_MAX_CELLS, with the sort kernel as the
+general-case fallback.
+
+Mechanics per event (same event stream as linear_scan — packing.py):
+
+  OPEN w:  latch (f, a, b) into slot registers, mark the slot open.
+  closure: repeat until fixpoint (≤W sweeps): for each slot w (static
+           unroll), configurations without bit w flow through the slot's
+           transition matrix T_w[s, s'] = legal(s) & (step(s) == s') into
+           the bit-w=1 half — a butterfly reshape exposing bit w as its
+           own axis plus an [?, S] @ [S, S] matmul.
+  FORCE w: survivors must hold bit w (mask with the static bit column),
+           then the bit is recycled by moving the bit-w=1 half onto the
+           bit-w=0 half (the same butterfly, in reverse). The dynamic
+           slot id selects among W static branches via `lax.switch`.
+
+The domain table `val_of[S]` is a per-history *input* (id 0 = initial
+state), so one compiled kernel serves a whole batch of histories with
+different value sets; padding repeats id 0, which is harmless (duplicate
+ids transition identically; the search just mirrors them).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
+
+#: Eligibility caps. Per-event work is ~W · 2^W · S² (closure sweeps) and
+#: W · 2^W · S (the vmapped switch evaluates every branch), so the dense
+#: path is reserved for genuinely small problems — which the reference's
+#: own workload shapes are (window ≈ n_procs, domain ≈ 5 values).
+DENSE_MAX_SLOTS = 8
+DENSE_MAX_STATES = 16
+DENSE_MAX_CELLS = 4096  # 2^W · S
+
+
+def dense_plan(model, encs: Sequence[EncodedHistory]):
+    """Decide whether a batch can run on the dense kernel.
+
+    Returns (n_slots, n_states, val_of[B, S]) or None. All histories must
+    have an enumerable domain (model.dense_domain) and fit the caps; the
+    kernel shape is the batch maximum, domains are padded with their own
+    id-0 (initial) value.
+    """
+    domains = []
+    for e in encs:
+        d = model.dense_domain(e.events)
+        if d is None:
+            return None
+        domains.append(np.asarray(d, dtype=np.int32))
+    W = max((e.n_slots for e in encs), default=0)
+    S = max((len(d) for d in domains), default=1)
+    if W > DENSE_MAX_SLOTS or S > DENSE_MAX_STATES or (1 << W) * S > \
+            DENSE_MAX_CELLS:
+        return None
+    # Bucket S to a power of two: domain sizes drift batch to batch (new
+    # values appear) and each (W, S) pair is a fresh XLA compile; padding
+    # states is cheap (S² sits in a tiny matmul), stable shapes are not.
+    # W stays exact — its cost is exponential.
+    S_b = 1
+    while S_b < S:
+        S_b *= 2
+    S = S_b
+    val_of = np.empty((len(domains), S), dtype=np.int32)
+    for i, d in enumerate(domains):
+        val_of[i, : len(d)] = d
+        val_of[i, len(d):] = d[0]
+    return max(W, 1), S, val_of
+
+
+def make_dense_history_checker(model, n_slots: int, n_states: int):
+    """Build fn(events [E,5], val_of [S]) -> (valid, overflow=False)."""
+    W, S = int(n_slots), int(n_states)
+    M = 1 << W
+    slot_ids = jnp.arange(W, dtype=jnp.int32)
+    # [M, W] static: bit w of mask m.
+    bit_table = (np.arange(M)[:, None] >> np.arange(W)[None, :]) & 1
+
+    def expand_w(w, F, val_of, slot_f, slot_a, slot_b, slot_open):
+        """One slot's flow: configs without bit w linearize op w."""
+        ns, legal = model.jax_step(val_of, slot_f[w], slot_a[w], slot_b[w])
+        T = ((ns[:, None] == val_of[None, :]) & legal[:, None] &
+             slot_open[w]).astype(jnp.float32)  # [S, S]
+        Fb = F.reshape(M >> (w + 1), 2, 1 << w, S)
+        src = Fb[:, 0].reshape(-1, S).astype(jnp.float32)
+        contrib = (src @ T).reshape(M >> (w + 1), 1 << w, S) > 0
+        return jnp.concatenate(
+            [Fb[:, :1], (Fb[:, 1] | contrib)[:, None]], axis=1
+        ).reshape(M, S)
+
+    def closure(F, val_of, slot_f, slot_a, slot_b, slot_open, active):
+        def cond(c):
+            return c[0]
+
+        def body(c):
+            _, it, F = c
+            F0 = F
+            for w in range(W):  # static unroll; sweeps chain w ascending
+                F = expand_w(w, F, val_of, slot_f, slot_a, slot_b,
+                             slot_open)
+            changed = jnp.any(F != F0)
+            return (changed & (it < W), it + 1, F)
+
+        _, _, F = lax.while_loop(cond, body, (active, jnp.int32(0), F))
+        return F
+
+    def scan_step(carry, ev):
+        F, slot_f, slot_a, slot_b, slot_open, ok, val_of = carry
+        etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+        is_open = etype == EV_OPEN
+        is_force = etype == EV_FORCE
+
+        onehot = slot_ids == slot
+        upd = onehot & is_open
+        slot_f = jnp.where(upd, f, slot_f)
+        slot_a = jnp.where(upd, a, slot_a)
+        slot_b = jnp.where(upd, b, slot_b)
+        slot_open = jnp.where(upd, True, slot_open)
+
+        F = closure(F, val_of, slot_f, slot_a, slot_b, slot_open, is_force)
+
+        # Dynamic slot id → one of W static butterfly branches. Under
+        # vmap the switch lowers to select-over-all-branches; each branch
+        # is a few [M, S] elementwise ops, so that stays cheap.
+        slot_w = jnp.clip(slot, 0, W - 1)
+        F_forced, alive = lax.switch(slot_w, force_branches, F)
+        F = jnp.where(is_force, F_forced, F)
+        ok = ok & (~is_force | alive)
+        slot_open = slot_open & ~(onehot & is_force)
+        return (F, slot_f, slot_a, slot_b, slot_open, ok, val_of), None
+
+    # One lax.switch branch per slot: kill configurations missing bit w
+    # (the FORCEd op must have linearized), then recycle the bit by moving
+    # the bit-w=1 half of the butterfly onto the bit-w=0 half.
+    def _mk_branch(w):
+        has = jnp.asarray(bit_table[:, w], bool)
+
+        def branch(F):
+            Fk = F & has[:, None]
+            alive = jnp.any(Fk)
+            Fb = Fk.reshape(M >> (w + 1), 2, 1 << w, S)
+            moved = jnp.concatenate(
+                [Fb[:, 1:2], jnp.zeros_like(Fb[:, 1:2])], axis=1
+            ).reshape(M, S)
+            return moved, alive
+
+        return branch
+
+    force_branches = [_mk_branch(w) for w in range(W)]
+
+    def check(events, val_of):
+        F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
+        carry = (
+            F,
+            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
+            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
+            jnp.bool_(True), val_of,
+        )
+        carry, _ = lax.scan(scan_step, carry, events)
+        # The dense frontier cannot overflow: the array is the whole
+        # configuration space. Second output mirrors the sort kernel's
+        # (valid, overflow) contract.
+        return carry[5], jnp.bool_(False)
+
+    return check
+
+
+_KERNEL_CACHE: dict = {}
+
+
+def make_dense_batch_checker(model, n_slots: int, n_states: int,
+                             jit: bool = True):
+    """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B])."""
+    key = (type(model), model.init_state(), int(n_slots), int(n_states), jit)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        single = make_dense_history_checker(model, n_slots, n_states)
+        fn = jax.vmap(single)
+        if jit:
+            fn = jax.jit(fn)
+        _KERNEL_CACHE[key] = fn
+    return fn

@@ -11,15 +11,24 @@ mkdir -p /root/.ssh && chmod 700 /root/.ssh
 cp /root/.secrets/id_ed25519 /root/.ssh/id_ed25519
 chmod 600 /root/.ssh/id_ed25519
 
-echo "waiting for ${EXPECTED} workers to register..."
-while [ "$(sort -u /var/jgraft/shared/nodes 2>/dev/null | wc -l)" -lt "$EXPECTED" ]; do
+# The shared registry is append-only and the volume may survive a
+# previous cluster generation, so stale names can linger. Count only
+# names that actually resolve in THIS network's DNS — a dead entry can
+# neither satisfy the quota nor wedge the wait.
+echo "waiting for ${EXPECTED} resolvable workers..."
+while :; do
+    : > /root/nodes.tmp
+    for node in $(sort -u /var/jgraft/shared/nodes 2>/dev/null); do
+        if getent hosts "$node" > /dev/null 2>&1; then
+            echo "$node" >> /root/nodes.tmp
+        fi
+    done
+    if [ "$(wc -l < /root/nodes.tmp)" -ge "$EXPECTED" ]; then
+        break
+    fi
     sleep 1
 done
-sort -u /var/jgraft/shared/nodes > /root/nodes
-
-while read -r node; do
-    until getent hosts "$node" > /dev/null; do sleep 1; done
-done < /root/nodes
+mv /root/nodes.tmp /root/nodes
 
 echo "cluster ready:"; cat /root/nodes
 echo "run: docker compose exec control bash"

@@ -1,0 +1,165 @@
+"""Dense-bitset kernel: correctness against the goldens and the CPU twin.
+
+The dense kernel (ops/dense_scan.py) is an alternate exact representation
+of the same search the sort kernel runs; every test here is differential —
+same verdicts as the unbounded CPU frontier and the sort kernel — plus
+routing tests that pin when the checker auto-selects it.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
+from jepsen_jgroups_raft_tpu.history.ops import (INFO, INVOKE, OK, History,
+                                                 Op)
+from jepsen_jgroups_raft_tpu.history.packing import encode_history, pack_batch
+from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+from jepsen_jgroups_raft_tpu.models.counter import Counter
+from jepsen_jgroups_raft_tpu.models.register import CasRegister
+from jepsen_jgroups_raft_tpu.ops.dense_scan import (DENSE_MAX_SLOTS,
+                                                    dense_plan,
+                                                    make_dense_batch_checker)
+
+
+def _h(rows):
+    h = History()
+    for r in rows:
+        h.append(Op(*r))
+    return h
+
+
+def test_register_domain_enumeration():
+    m = CasRegister()
+    h = _h([(0, INVOKE, "write", 3), (0, OK, "write", 3),
+            (1, INVOKE, "cas", (3, 9)), (1, OK, "cas", (3, 9)),
+            (2, INVOKE, "read", None), (2, OK, "read", 9)])
+    enc = encode_history(h, m)
+    dom = m.dense_domain(enc.events)
+    # initial (NIL) first, then writes ∪ cas-to — read values excluded.
+    assert dom[0] == m.init_state()
+    assert set(dom[1:]) == {3, 9}
+
+
+def test_counter_has_no_dense_domain():
+    m = Counter()
+    h = _h([(0, INVOKE, "add", 1), (0, OK, "add", 1)])
+    enc = encode_history(h, m)
+    assert m.dense_domain(enc.events) is None
+    assert dense_plan(m, [enc]) is None
+
+
+def test_plan_rejects_wide_windows():
+    m = CasRegister()
+    width = DENSE_MAX_SLOTS + 2
+    h = History()
+    for p in range(width):
+        h.append(Op(p, INVOKE, "write", 1))
+    for p in range(width):
+        h.append(Op(p, OK, "write", 1))
+    enc = encode_history(h, m)
+    assert enc.n_slots == width
+    assert dense_plan(m, [enc]) is None
+
+
+def test_auto_routes_register_to_dense_kernel():
+    rs = check_histories(
+        [_h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+             (1, INVOKE, "read", None), (1, OK, "read", 1)])],
+        CasRegister(), algorithm="jax")
+    assert rs[0]["valid?"] is True
+    assert rs[0]["kernel"] == "dense"
+
+
+def test_dense_verdicts_on_goldens():
+    m = CasRegister()
+    valid = _h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+                (1, INVOKE, "read", None), (1, OK, "read", 1)])
+    invalid = _h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+                  (1, INVOKE, "read", None), (1, OK, "read", 2)])
+    # Info write observed later: valid, and the crashed slot never forces.
+    info_applied = _h([(0, INVOKE, "write", 7), (0, INFO, "write", 7),
+                       (1, INVOKE, "read", None), (1, OK, "read", 7)])
+    # Info write must not be REQUIRED to have applied.
+    info_optional = _h([(0, INVOKE, "write", 7), (0, INFO, "write", 7),
+                        (1, INVOKE, "read", None), (1, OK, "read", None)])
+    rs = check_histories([valid, invalid, info_applied, info_optional],
+                         m, algorithm="jax")
+    assert [r["valid?"] for r in rs] == [True, False, True, True]
+    assert all(r["kernel"] == "dense" for r in rs)
+
+
+def test_nonzero_initial_value():
+    m = CasRegister(initial=5)
+    ok = _h([(0, INVOKE, "read", None), (0, OK, "read", 5),
+             (1, INVOKE, "cas", (5, 2)), (1, OK, "cas", (5, 2)),
+             (2, INVOKE, "read", None), (2, OK, "read", 2)])
+    bad = _h([(0, INVOKE, "read", None), (0, OK, "read", 0)])
+    rs = check_histories([ok, bad], m, algorithm="jax")
+    assert [r["valid?"] for r in rs] == [True, False]
+    assert rs[0]["kernel"] == "dense"
+
+
+def test_heterogeneous_domains_in_one_batch():
+    m = CasRegister()
+    h1 = _h([(0, INVOKE, "write", 100), (0, OK, "write", 100),
+             (1, INVOKE, "read", None), (1, OK, "read", 100)])
+    h2 = _h([(0, INVOKE, "write", -3), (0, OK, "write", -3),
+             (1, INVOKE, "read", None), (1, OK, "read", -3)])
+    h3 = _h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+             (1, INVOKE, "read", None), (1, OK, "read", 2)])
+    rs = check_histories([h1, h2, h3], m, algorithm="jax")
+    assert [r["valid?"] for r in rs] == [True, True, False]
+
+
+@pytest.mark.parametrize("crash_p", [0.0, 0.15])
+def test_differential_random_histories_vs_cpu(crash_p):
+    """Dense kernel verdicts == unbounded CPU frontier on random valid and
+    corrupted register histories (the same protocol the sort kernel's
+    differential test uses)."""
+    m = CasRegister()
+    rng = random.Random(77)
+    encs, hists = [], []
+    for i in range(40):
+        h = random_valid_history(rng, "register", n_ops=60, n_procs=4,
+                                 crash_p=crash_p)
+        if i % 2:  # corrupt half: flip one ok-read's value
+            ops = list(h)
+            reads = [j for j, op in enumerate(ops)
+                     if op.type == OK and op.f == "read"
+                     and op.value is not None]
+            if reads:
+                j = rng.choice(reads)
+                ops[j] = ops[j].replace(value=ops[j].value + 1)
+                h = ops
+        hists.append(h)
+        encs.append(encode_history(h, m))
+
+    plan = dense_plan(m, encs)
+    assert plan is not None
+    d_slots, d_states, val_of = plan
+    kernel = make_dense_batch_checker(m, d_slots, d_states)
+    ok, overflow = kernel(pack_batch(encs)["events"], val_of)
+    assert not np.asarray(overflow).any()
+    for i, enc in enumerate(encs):
+        expect = check_encoded_cpu(enc, m).valid
+        assert bool(ok[i]) is expect, f"history {i}: dense != cpu"
+
+
+def test_read_of_unreachable_value_dies():
+    m = CasRegister()
+    h = _h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+            (1, INVOKE, "read", None), (1, OK, "read", 42)])  # 42 ∉ domain
+    rs = check_histories([h], m, algorithm="jax")
+    assert rs[0]["valid?"] is False
+
+
+def test_pinned_capacity_keeps_sort_kernel():
+    """Explicit n_configs is a sort-kernel knob: pinning it must bypass
+    the dense path (capacity-escalation tests depend on it)."""
+    h = _h([(0, INVOKE, "write", 1), (0, OK, "write", 1)])
+    rs = check_histories([h], CasRegister(), algorithm="jax", n_configs=64)
+    assert rs[0]["valid?"] is True
+    assert rs[0].get("kernel") == "sort"
