@@ -36,36 +36,52 @@ def random_valid_history(
     n_procs: int = 3,
     value_range: int = 3,
     crash_p: float = 0.2,
+    max_crashes: int | None = None,
 ) -> History:
-    """Generate a linearizable-by-construction history.
+    """Generate a linearizable-by-construction history of n_ops ops.
 
     model_kind: "register" (read/write/cas) or "counter"
     (read/add/add-and-get). crash_p biases how often a pending op crashes
     instead of completing (info ops are the checker-pressure knob).
-    """
 
+    A crashed process is REPLACED by a fresh process id, the way jepsen's
+    runner remaps crashed worker ids — so the history really reaches n_ops
+    regardless of crashes. (Round-2 bug: crashed processes used to retire,
+    so every "1000-op" benchmark history silently ended after the ~5th
+    crash at a median of ~75 ops.) Every crashed op holds a concurrency-
+    window slot forever, so `max_crashes` caps the total — the knob that
+    keeps long histories inside a checkable window. The default (None)
+    caps at n_procs: the concurrency window stays ≤ 2·n_procs no matter
+    how long the history, and it matches the most crashes the pre-fix
+    generator could ever produce. An uncapped run (windows in the
+    hundreds, beyond every checker) must be asked for with
+    max_crashes=n_ops."""
+
+    if max_crashes is None:
+        max_crashes = n_procs
     state = None if model_kind == "register" else 0
     rows = []
     # pending: process -> dict(f, value, linearized?, result)
     pending: dict = {}
     done_ops = 0
+    crashes = 0
     free = list(range(n_procs))
+    next_pid = n_procs
     while done_ops < n_ops or pending:
         choices = []
         if done_ops < n_ops and free:
             choices.append("invoke")
         unlin = [p for p, d in pending.items() if not d["lin"]]
         lin = [p for p, d in pending.items() if d["lin"]]
+        may_crash = crashes < max_crashes
         if unlin:
             choices.append("linearize")
-            if rng.random() < crash_p:
+            if may_crash and rng.random() < crash_p:
                 choices.append("crash_unapplied")
         if lin:
             choices.append("complete")
-            if rng.random() < crash_p:
+            if may_crash and rng.random() < crash_p:
                 choices.append("crash_applied")
-        if not choices:  # every process crashed before n_ops were issued
-            break
         act = rng.choice(choices)
         if act == "invoke":
             p = free.pop(rng.randrange(len(free)))
@@ -123,9 +139,15 @@ def random_valid_history(
             else:
                 rows.append((p, OK, f, d["value"]))
             free.append(p)
-        else:  # crash (applied or not): completion unknown, process retires
+        else:
+            # Crash (applied or not): completion unknown. The op's slot
+            # stays open forever; the worker comes back under a fresh
+            # process id (jepsen's crashed-id remapping).
             p = rng.choice(lin if act == "crash_applied" else unlin)
             d = pending.pop(p)
+            crashes += 1
+            free.append(next_pid)
+            next_pid += 1
             if rng.random() < 0.5:
                 rows.append((p, INFO, d["f"], d["value"]))
             # else: no completion row at all — pair_ops treats the dangling

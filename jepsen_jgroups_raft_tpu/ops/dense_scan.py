@@ -54,10 +54,11 @@ from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
 #: Eligibility caps. Per-event work is ~W · 2^W · S² (closure sweeps) and
 #: W · 2^W · S (the vmapped switch evaluates every branch), so the dense
 #: path is reserved for genuinely small problems — which the reference's
-#: own workload shapes are (window ≈ n_procs, domain ≈ 5 values).
-DENSE_MAX_SLOTS = 8
+#: own workload shapes are (window ≈ n_procs, domain ≈ 5 values; a few
+#: crashed ops' never-retiring slots push long histories to W ≈ 10).
+DENSE_MAX_SLOTS = 10
 DENSE_MAX_STATES = 16
-DENSE_MAX_CELLS = 4096  # 2^W · S
+DENSE_MAX_CELLS = 8192  # 2^W · S
 
 
 def dense_plan(model, encs: Sequence[EncodedHistory]):

@@ -124,7 +124,7 @@ def test_differential_random_histories_vs_cpu(crash_p):
     encs, hists = [], []
     for i in range(40):
         h = random_valid_history(rng, "register", n_ops=60, n_procs=4,
-                                 crash_p=crash_p)
+                                 crash_p=crash_p, max_crashes=3)
         if i % 2:  # corrupt half: flip one ok-read's value
             ops = list(h)
             reads = [j for j, op in enumerate(ops)

@@ -20,7 +20,8 @@ def test_independent_10k_op_histories_verify():
     rng = random.Random(4)
     model = CasRegister()
     hs = [random_valid_history(rng, "register", n_ops=10_000, n_procs=5,
-                               crash_p=0.02) for _ in range(2)]
+                               crash_p=0.02, max_crashes=4)
+          for _ in range(2)]
     res = check_histories(hs, model, algorithm="jax")
     assert all(r["valid?"] is True for r in res)
     assert all(r["algorithm"] == "jax" for r in res)
@@ -31,7 +32,7 @@ def test_single_50k_op_history_verifies():
     rng = random.Random(5)
     model = CasRegister()
     h = random_valid_history(rng, "register", n_ops=50_000, n_procs=5,
-                             crash_p=0.01)
+                             crash_p=0.01, max_crashes=4)
     res = check_histories([h], model, algorithm="jax")
     assert res[0]["valid?"] is True
     assert res[0]["algorithm"] == "jax"
