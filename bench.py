@@ -128,8 +128,8 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "n_histories": n_histories,
         "n_ops": n_ops,
         "n_procs": n_procs,
-        "kernel": "dense" if plan is not None else "sort",
-        "concurrency_window": plan[0] if plan is not None else n_slots,
+        "kernel": "sort" if plan is None else plan.kernel_tag,
+        "concurrency_window": plan.n_slots if plan is not None else n_slots,
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),

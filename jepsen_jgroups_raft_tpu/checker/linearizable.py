@@ -130,11 +130,11 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None):
         plan = (dense_plan(model, [encs[i] for i in fits])
                 if n_configs is None and n_slots is None else None)
         if plan is not None:
-            d_slots, d_states, val_of = plan
             batch = pack_batch([encs[i] for i in fits])
             ev, (val_of,), B = pad_batch_bucketed(batch["events"],
-                                                  (val_of,))
-            kernel = make_dense_batch_checker(model, d_slots, d_states)
+                                                  (plan.val_of,))
+            kernel = make_dense_batch_checker(model, plan.kind,
+                                              plan.n_slots, plan.n_states)
             t0 = time.perf_counter()
             with _maybe_profile():
                 ok, _ = kernel(ev, val_of)
@@ -142,7 +142,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None):
             dt = time.perf_counter() - t0
             for j, i in enumerate(fits):
                 results[i] = _jx(VALID if ok[j] else INVALID, encs[i],
-                                 dt / len(fits), kernel="dense")
+                                 dt / len(fits), kernel=plan.kernel_tag)
             return results
 
         eff_slots = n_slots or bucket_slots(

@@ -87,6 +87,19 @@ class Model:
         (ops/dense_scan.py); the default keeps the general sort kernel."""
         return None
 
+    #: True when the state after linearizing a SET of ops is independent
+    #: of their order (e.g. a counter: state = initial + Σ deltas). Such
+    #: models need no state dimension at all in the dense kernel — the
+    #: frontier is a bare bitset over window masks, with per-mask states
+    #: derived from `mask_delta` subset sums (ops/dense_scan.py mask mode).
+    mask_determined = False
+
+    def mask_delta(self, f, a, b):
+        """Vectorized: the state delta op (f, a, b) contributes when
+        linearized (0 for pure reads). Only consulted when
+        `mask_determined` is True."""
+        raise NotImplementedError
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         raise NotImplementedError
 

@@ -57,6 +57,14 @@ class Counter(Model):
         new_state = jnp.where(f == READ, state, added)
         return new_state, legal
 
+    # State after a set of linearized ops = initial + Σ deltas, regardless
+    # of order — the property the mask-mode dense kernel exploits
+    # (ops/dense_scan.py): the frontier needs no state dimension.
+    mask_determined = True
+
+    def mask_delta(self, f, a, b):
+        return jnp.where(f == READ, 0, a)
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         f = pair.f
         forced = pair.ctype == OK

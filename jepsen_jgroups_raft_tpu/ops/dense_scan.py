@@ -42,6 +42,7 @@ ids transition identically; the search just mirrors them).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax
@@ -60,39 +61,121 @@ DENSE_MAX_SLOTS = 10
 DENSE_MAX_STATES = 16
 DENSE_MAX_CELLS = 8192  # 2^W · S
 
+#: Mask mode has no state dimension (S² → 1), so it affords a wider
+#: window: 2^12 bool cells + an int32 subset-sum lane per history.
+MASK_DENSE_MAX_SLOTS = 12
 
-def dense_plan(model, encs: Sequence[EncodedHistory]):
-    """Decide whether a batch can run on the dense kernel.
 
-    Returns (n_slots, n_states, val_of[B, S]) or None. All histories must
-    have an enumerable domain (model.dense_domain) and fit the caps; the
-    kernel shape is the batch maximum, domains are padded with their own
-    id-0 (initial) value.
+@dataclass(frozen=True)
+class DensePlan:
+    """How to run a batch on a dense kernel.
+
+    kind "domain": frontier F[2^W, S] over an enumerated value domain;
+    `val_of` [B, S] is the per-history id→value table (kernel input).
+    kind "mask": frontier F[2^W] for order-independent models
+    (model.mask_determined) — per-mask states are subset sums; `val_of`
+    is a [B, 1] dummy so both kinds share the (events, val_of) calling
+    convention through the batch/mesh plumbing.
     """
+
+    kind: str
+    n_slots: int
+    n_states: int
+    val_of: np.ndarray
+
+    @property
+    def kernel_tag(self) -> str:
+        """Reporting label (checker results, bench JSON)."""
+        return "dense" if self.kind == "domain" else "dense-mask"
+
+
+def dense_plan(model, encs: Sequence[EncodedHistory]) -> Optional[DensePlan]:
+    """Decide whether a batch can run on a dense kernel (domain mode
+    first, mask mode second), or None → the general sort kernel. The
+    kernel shape is the batch maximum; domain tables are padded with
+    their own id-0 (initial) value."""
+    W = max((e.n_slots for e in encs), default=0)
     domains = []
     for e in encs:
         d = model.dense_domain(e.events)
         if d is None:
-            return None
+            domains = None
+            break
         domains.append(np.asarray(d, dtype=np.int32))
-    W = max((e.n_slots for e in encs), default=0)
-    S = max((len(d) for d in domains), default=1)
-    if W > DENSE_MAX_SLOTS or S > DENSE_MAX_STATES or (1 << W) * S > \
-            DENSE_MAX_CELLS:
-        return None
-    # Bucket S to a power of two: domain sizes drift batch to batch (new
-    # values appear) and each (W, S) pair is a fresh XLA compile; padding
-    # states is cheap (S² sits in a tiny matmul), stable shapes are not.
-    # W stays exact — its cost is exponential.
-    S_b = 1
-    while S_b < S:
-        S_b *= 2
-    S = S_b
-    val_of = np.empty((len(domains), S), dtype=np.int32)
-    for i, d in enumerate(domains):
-        val_of[i, : len(d)] = d
-        val_of[i, len(d):] = d[0]
-    return max(W, 1), S, val_of
+    if domains is not None:
+        S = max((len(d) for d in domains), default=1)
+        if W <= DENSE_MAX_SLOTS and S <= DENSE_MAX_STATES and \
+                (1 << W) * S <= DENSE_MAX_CELLS:
+            # Bucket S to a power of two: domain sizes drift batch to
+            # batch (new values appear) and each (W, S) pair is a fresh
+            # XLA compile; padding states is cheap (S² sits in a tiny
+            # matmul), stable shapes are not. W stays exact — its cost
+            # is exponential.
+            S_b = 1
+            while S_b < S:
+                S_b *= 2
+            S = S_b
+            val_of = np.empty((len(domains), S), dtype=np.int32)
+            for i, d in enumerate(domains):
+                val_of[i, : len(d)] = d
+                val_of[i, len(d):] = d[0]
+            return DensePlan("domain", max(W, 1), S, val_of)
+    if model.mask_determined and W <= MASK_DENSE_MAX_SLOTS:
+        dummy = np.zeros((len(encs), 1), dtype=np.int32)
+        return DensePlan("mask", max(W, 1), 1, dummy)
+    return None
+
+
+def _bit_table(M: int, W: int) -> np.ndarray:
+    """[M, W] static table: bit w of mask m."""
+    return (np.arange(M)[:, None] >> np.arange(W)[None, :]) & 1
+
+
+def _closure_fixpoint(W: int, sweep, F, active):
+    """Iterate `sweep` (one pass over all slots) to the reachability
+    fixpoint. Each productive sweep extends every pending linearization
+    chain by ≥1 op and chains are ≤W long, so ≤W sweeps suffice; the
+    change test is exact even when the frontier representation holds
+    redundant entries (it compares the whole array). `active`
+    short-circuits non-FORCE events."""
+
+    def cond(c):
+        return c[0]
+
+    def body(c):
+        _, it, F = c
+        F0 = F
+        F = sweep(F)
+        return (jnp.any(F != F0) & (it < W), it + 1, F)
+
+    _, _, F = lax.while_loop(cond, body, (active, jnp.int32(0), F))
+    return F
+
+
+def _make_force_branches(bit_table: np.ndarray, W: int, S: int):
+    """One lax.switch branch per slot for an [M, S] frontier: kill
+    configurations missing bit w (the FORCEd op must have linearized),
+    then recycle the bit by moving the bit-w=1 half of the butterfly onto
+    the bit-w=0 half. Under vmap the switch lowers to select-over-all-
+    branches; each branch is a few [M, S] elementwise ops, so that stays
+    cheap."""
+    M = bit_table.shape[0]
+
+    def _mk(w):
+        has = jnp.asarray(bit_table[:, w], bool)
+
+        def branch(F):
+            Fk = F & has[:, None]
+            alive = jnp.any(Fk)
+            Fb = Fk.reshape(M >> (w + 1), 2, 1 << w, S)
+            moved = jnp.concatenate(
+                [Fb[:, 1:2], jnp.zeros_like(Fb[:, 1:2])], axis=1
+            ).reshape(M, S)
+            return moved, alive
+
+        return branch
+
+    return [_mk(w) for w in range(W)]
 
 
 def make_dense_history_checker(model, n_slots: int, n_states: int):
@@ -100,8 +183,8 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
     W, S = int(n_slots), int(n_states)
     M = 1 << W
     slot_ids = jnp.arange(W, dtype=jnp.int32)
-    # [M, W] static: bit w of mask m.
-    bit_table = (np.arange(M)[:, None] >> np.arange(W)[None, :]) & 1
+    bit_table = _bit_table(M, W)
+    force_branches = _make_force_branches(bit_table, W, S)
 
     def expand_w(w, F, val_of, slot_f, slot_a, slot_b, slot_open):
         """One slot's flow: configs without bit w linearize op w."""
@@ -114,22 +197,6 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         return jnp.concatenate(
             [Fb[:, :1], (Fb[:, 1] | contrib)[:, None]], axis=1
         ).reshape(M, S)
-
-    def closure(F, val_of, slot_f, slot_a, slot_b, slot_open, active):
-        def cond(c):
-            return c[0]
-
-        def body(c):
-            _, it, F = c
-            F0 = F
-            for w in range(W):  # static unroll; sweeps chain w ascending
-                F = expand_w(w, F, val_of, slot_f, slot_a, slot_b,
-                             slot_open)
-            changed = jnp.any(F != F0)
-            return (changed & (it < W), it + 1, F)
-
-        _, _, F = lax.while_loop(cond, body, (active, jnp.int32(0), F))
-        return F
 
     def scan_step(carry, ev):
         F, slot_f, slot_a, slot_b, slot_open, ok, val_of = carry
@@ -144,36 +211,20 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         slot_b = jnp.where(upd, b, slot_b)
         slot_open = jnp.where(upd, True, slot_open)
 
-        F = closure(F, val_of, slot_f, slot_a, slot_b, slot_open, is_force)
+        def sweep(F):  # static unroll; expansions chain w ascending
+            for w in range(W):
+                F = expand_w(w, F, val_of, slot_f, slot_a, slot_b,
+                             slot_open)
+            return F
 
-        # Dynamic slot id → one of W static butterfly branches. Under
-        # vmap the switch lowers to select-over-all-branches; each branch
-        # is a few [M, S] elementwise ops, so that stays cheap.
+        F = _closure_fixpoint(W, sweep, F, is_force)
+
         slot_w = jnp.clip(slot, 0, W - 1)
         F_forced, alive = lax.switch(slot_w, force_branches, F)
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~(onehot & is_force)
         return (F, slot_f, slot_a, slot_b, slot_open, ok, val_of), None
-
-    # One lax.switch branch per slot: kill configurations missing bit w
-    # (the FORCEd op must have linearized), then recycle the bit by moving
-    # the bit-w=1 half of the butterfly onto the bit-w=0 half.
-    def _mk_branch(w):
-        has = jnp.asarray(bit_table[:, w], bool)
-
-        def branch(F):
-            Fk = F & has[:, None]
-            alive = jnp.any(Fk)
-            Fb = Fk.reshape(M >> (w + 1), 2, 1 << w, S)
-            moved = jnp.concatenate(
-                [Fb[:, 1:2], jnp.zeros_like(Fb[:, 1:2])], axis=1
-            ).reshape(M, S)
-            return moved, alive
-
-        return branch
-
-    force_branches = [_mk_branch(w) for w in range(W)]
 
     def check(events, val_of):
         F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
@@ -192,16 +243,112 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
     return check
 
 
+def make_mask_dense_history_checker(model, n_slots: int):
+    """Mask-mode kernel for order-independent models (counter): the
+    frontier is a bare bitset F[2^W] — config m's state is
+    base + sums[m], where `sums` holds the subset sum of the open slots'
+    deltas (maintained incrementally at OPEN/FORCE with one [M] op) and
+    `base` absorbs the delta of every retired op. Legality reuses the
+    model's own vectorized jax_step on the derived state vector.
+
+    Returns fn(events [E,5], val_of [1] ignored) -> (valid, False) — the
+    dummy second operand keeps both dense kinds on one calling convention
+    through the batch/mesh plumbing. The frontier is carried as [M, 1] so
+    the force branches are shared with the domain kernel."""
+    W = int(n_slots)
+    M = 1 << W
+    slot_ids = jnp.arange(W, dtype=jnp.int32)
+    bit_table = _bit_table(M, W)
+    bit_i32 = jnp.asarray(bit_table, jnp.int32)   # [M, W]
+    force_branches = _make_force_branches(bit_table, W, 1)
+
+    def expand_w(w, F, base, sums, slot_f, slot_a, slot_b, slot_open):
+        state = base + sums  # [M]
+        _, legal = model.jax_step(state, slot_f[w], slot_a[w], slot_b[w])
+        legal = legal & slot_open[w]
+        Fb = F.reshape(M >> (w + 1), 2, 1 << w, 1)
+        Lb = legal.reshape(M >> (w + 1), 2, 1 << w)
+        grown = Fb[:, 1] | (Fb[:, 0] & Lb[:, 0][..., None])
+        return jnp.concatenate([Fb[:, :1], grown[:, None]],
+                               axis=1).reshape(M, 1)
+
+    def scan_step(carry, ev):
+        F, base, sums, slot_delta, slot_f, slot_a, slot_b, slot_open, ok = \
+            carry
+        etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+        is_open = etype == EV_OPEN
+        is_force = etype == EV_FORCE
+
+        onehot = slot_ids == slot
+        upd = onehot & is_open
+        slot_f = jnp.where(upd, f, slot_f)
+        slot_a = jnp.where(upd, a, slot_a)
+        slot_b = jnp.where(upd, b, slot_b)
+        slot_open = jnp.where(upd, True, slot_open)
+        # Maintain sums[m] = Σ_w bit_w(m) · slot_delta[w] as slot w's
+        # delta changes from its stale value to this op's.
+        col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)  # [M]
+        old_d = jnp.sum(jnp.where(onehot, slot_delta, 0))
+        new_d = model.mask_delta(f, a, b)
+        sums = jnp.where(is_open, sums + col * (new_d - old_d), sums)
+        slot_delta = jnp.where(upd, new_d, slot_delta)
+
+        def sweep(F):
+            for w in range(W):
+                F = expand_w(w, F, base, sums, slot_f, slot_a, slot_b,
+                             slot_open)
+            return F
+
+        F = _closure_fixpoint(W, sweep, F, is_force)
+
+        F_forced, alive = lax.switch(jnp.clip(slot, 0, W - 1),
+                                     force_branches, F)
+        F = jnp.where(is_force, F_forced, F)
+        ok = ok & (~is_force | alive)
+        # Retire the forced op: its delta is now part of every survivor's
+        # permanent prefix (base), and its slot leaves the open set.
+        base = base + jnp.where(is_force, old_d, 0)
+        sums = jnp.where(is_force, sums - col * old_d, sums)
+        slot_delta = jnp.where(onehot & is_force, 0, slot_delta)
+        slot_open = slot_open & ~(onehot & is_force)
+        return (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+                slot_open, ok), None
+
+    def check(events, val_of):
+        del val_of  # calling-convention dummy (see docstring)
+        F = jnp.zeros((M, 1), dtype=bool).at[0, 0].set(True)
+        carry = (
+            F, jnp.int32(model.init_state()),
+            jnp.zeros((M,), jnp.int32), jnp.zeros((W,), jnp.int32),
+            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
+            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
+            jnp.bool_(True),
+        )
+        carry, _ = lax.scan(scan_step, carry, events)
+        return carry[8], jnp.bool_(False)
+
+    return check
+
+
+def make_dense_single_checker(model, kind: str, n_slots: int,
+                              n_states: int):
+    """Unified single-history factory: fn(events [E,5], val_of [S])."""
+    if kind == "mask":
+        return make_mask_dense_history_checker(model, n_slots)
+    return make_dense_history_checker(model, n_slots, n_states)
+
+
 _KERNEL_CACHE: dict = {}
 
 
-def make_dense_batch_checker(model, n_slots: int, n_states: int,
+def make_dense_batch_checker(model, kind: str, n_slots: int, n_states: int,
                              jit: bool = True):
     """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B])."""
-    key = (type(model), model.init_state(), int(n_slots), int(n_states), jit)
+    key = (type(model), model.init_state(), kind, int(n_slots),
+           int(n_states), jit)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        single = make_dense_history_checker(model, n_slots, n_states)
+        single = make_dense_single_checker(model, kind, n_slots, n_states)
         fn = jax.vmap(single)
         if jit:
             fn = jax.jit(fn)

@@ -30,7 +30,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..history.packing import pad_batch_bucketed
-from ..ops.dense_scan import make_dense_history_checker
+from ..ops.dense_scan import make_dense_single_checker
 from ..ops.linear_scan import DEFAULT_N_CONFIGS, MAX_SLOTS, make_history_checker
 
 BATCH_AXIS = "data"
@@ -91,19 +91,19 @@ def sharded_batch_checker(model, mesh: Mesh,
     return fn
 
 
-def sharded_dense_checker(model, mesh: Mesh, n_slots: int, n_states: int,
-                          axis_name: str = BATCH_AXIS):
+def sharded_dense_checker(model, mesh: Mesh, kind: str, n_slots: int,
+                          n_states: int, axis_name: str = BATCH_AXIS):
     """Dense-bitset variant of `sharded_batch_checker`:
     fn(events [B,E,5], val_of [B,S]) -> (ok[B], overflow[B], n_valid,
-    n_unknown). Same mesh layout; the per-history domain table shards with
-    the batch."""
-    key = ("dense", type(model), model.init_state(), int(n_slots),
+    n_unknown). Same mesh layout; the per-history domain table (or the
+    mask-mode dummy) shards with the batch."""
+    key = ("dense", kind, type(model), model.init_state(), int(n_slots),
            int(n_states), tuple(mesh.devices.flat), axis_name)
     fn = _CACHE.get(key)
     if fn is not None:
         return fn
 
-    vm = jax.vmap(make_dense_history_checker(model, n_slots, n_states))
+    vm = jax.vmap(make_dense_single_checker(model, kind, n_slots, n_states))
 
     def local_step(ev, val_of):
         ok, overflow = vm(ev, val_of)
@@ -151,9 +151,9 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
     FORCE events → sliced off afterwards). Returns (ok[B], overflow[B],
     n_valid, n_unknown) host values corrected for padding.
 
-    `dense` — a (n_slots, n_states, val_of[B, S]) plan from
-    `ops.dense_scan.dense_plan` — routes the batch to the dense-bitset
-    kernel: exact, ladder-free, ~10× on small-domain workloads.
+    `dense` — a `ops.dense_scan.DensePlan` — routes the batch to the
+    dense-bitset kernel (domain or mask mode): exact, ladder-free, ~10×+
+    on small-domain / order-independent workloads.
 
     Capacity ladder otherwise (unless `n_configs` pins one rung): kernel
     cost is linear in the frontier capacity and "valid" at small capacity
@@ -163,13 +163,14 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
     """
     mesh = mesh or make_mesh()
     if dense is not None:
-        d_slots, d_states, val_of = dense
         axis_name = mesh.axis_names[0]
         events, (val_of,), B = pad_batch_bucketed(
-            events, (val_of,), floor_e=None, multiple_b=mesh.devices.size)
+            events, (dense.val_of,), floor_e=None,
+            multiple_b=mesh.devices.size)
         sharding = NamedSharding(mesh, P(axis_name, None, None))
         vsharding = NamedSharding(mesh, P(axis_name, None))
-        fn = sharded_dense_checker(model, mesh, d_slots, d_states, axis_name)
+        fn = sharded_dense_checker(model, mesh, dense.kind, dense.n_slots,
+                                   dense.n_states, axis_name)
         ok, overflow, _, _ = fn(jax.device_put(events, sharding),
                                 jax.device_put(val_of, vsharding))
         ok = np.asarray(ok)[:B]

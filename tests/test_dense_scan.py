@@ -43,12 +43,16 @@ def test_register_domain_enumeration():
     assert set(dom[1:]) == {3, 9}
 
 
-def test_counter_has_no_dense_domain():
+def test_counter_routes_to_mask_mode():
+    """The counter has no enumerable value domain, but its state is
+    order-independent (Σ deltas) — the plan falls through to mask mode."""
     m = Counter()
     h = _h([(0, INVOKE, "add", 1), (0, OK, "add", 1)])
     enc = encode_history(h, m)
     assert m.dense_domain(enc.events) is None
-    assert dense_plan(m, [enc]) is None
+    plan = dense_plan(m, [enc])
+    assert plan is not None and plan.kind == "mask"
+    assert plan.n_states == 1
 
 
 def test_plan_rejects_wide_windows():
@@ -138,14 +142,84 @@ def test_differential_random_histories_vs_cpu(crash_p):
         encs.append(encode_history(h, m))
 
     plan = dense_plan(m, encs)
-    assert plan is not None
-    d_slots, d_states, val_of = plan
-    kernel = make_dense_batch_checker(m, d_slots, d_states)
-    ok, overflow = kernel(pack_batch(encs)["events"], val_of)
+    assert plan is not None and plan.kind == "domain"
+    kernel = make_dense_batch_checker(m, plan.kind, plan.n_slots,
+                                      plan.n_states)
+    ok, overflow = kernel(pack_batch(encs)["events"], plan.val_of)
     assert not np.asarray(overflow).any()
     for i, enc in enumerate(encs):
         expect = check_encoded_cpu(enc, m).valid
         assert bool(ok[i]) is expect, f"history {i}: dense != cpu"
+
+
+@pytest.mark.parametrize("crash_p", [0.0, 0.15])
+def test_mask_mode_differential_counter_vs_cpu(crash_p):
+    """Mask-mode kernel verdicts == unbounded CPU frontier on random
+    valid and corrupted counter histories (incl. add-and-get ordering
+    constraints and optimistic info semantics)."""
+    m = Counter()
+    rng = random.Random(78)
+    encs = []
+    for i in range(40):
+        h = random_valid_history(rng, "counter", n_ops=50, n_procs=4,
+                                 crash_p=crash_p, max_crashes=3)
+        if i % 2:  # corrupt half: bump a completed read or an
+            # add-and-get's observed new value ((delta, new) tuple)
+            ops = list(h)
+            cands = [j for j, op in enumerate(ops)
+                     if op.type == OK and op.value is not None
+                     and op.f in ("read", "add-and-get")]
+            if cands:
+                j = rng.choice(cands)
+                if ops[j].f == "read":
+                    ops[j] = ops[j].replace(value=ops[j].value + 1)
+                else:
+                    delta, new = ops[j].value
+                    ops[j] = ops[j].replace(value=(delta, new + 1))
+                h = ops
+        encs.append(encode_history(h, m))
+
+    plan = dense_plan(m, encs)
+    assert plan is not None and plan.kind == "mask"
+    kernel = make_dense_batch_checker(m, plan.kind, plan.n_slots,
+                                      plan.n_states)
+    ok, overflow = kernel(pack_batch(encs)["events"], plan.val_of)
+    assert not np.asarray(overflow).any()
+    for i, enc in enumerate(encs):
+        expect = check_encoded_cpu(enc, m).valid
+        assert bool(ok[i]) is expect, f"history {i}: mask-dense != cpu"
+
+
+def test_mask_mode_counter_goldens():
+    """The reference's pinned CounterModel semantics through the mask
+    kernel (raft_test.clj's three cases live in test_checker.py; these
+    cover the kernel-facing essentials, incl. negative deltas)."""
+    m = Counter()
+    valid = _h([(0, INVOKE, "add", 2), (0, OK, "add", 2),
+                (1, INVOKE, "add-and-get", 3), (1, OK, "add-and-get", (3, 5)),
+                (2, INVOKE, "read", None), (2, OK, "read", 5)])
+    stale = _h([(0, INVOKE, "add", 2), (0, OK, "add", 2),
+                (1, INVOKE, "read", None), (1, OK, "read", 1)])
+    decr = _h([(0, INVOKE, "add", 4), (0, OK, "add", 4),
+               (1, INVOKE, "decr", 1), (1, OK, "decr", 1),
+               (2, INVOKE, "read", None), (2, OK, "read", 3)])
+    # info add may or may not apply: read of 0 AND read of 7 both fine,
+    # but only consistently (0 then 7 ok; 7 then 0 impossible).
+    info_ok = _h([(0, INVOKE, "add", 7), (0, INFO, "add", 7),
+                  (1, INVOKE, "read", None), (1, OK, "read", 0),
+                  (2, INVOKE, "read", None), (2, OK, "read", 7)])
+    info_bad = _h([(0, INVOKE, "add", 7), (0, INFO, "add", 7),
+                   (1, INVOKE, "read", None), (1, OK, "read", 7),
+                   (2, INVOKE, "read", None), (2, OK, "read", 0)])
+    # A wrong add-and-get observation must be caught (state+delta != new).
+    aag_bad = _h([(0, INVOKE, "add", 2), (0, OK, "add", 2),
+                  (1, INVOKE, "add-and-get", 3),
+                  (1, OK, "add-and-get", (3, 6))])
+    rs = check_histories([valid, stale, decr, info_ok, info_bad, aag_bad],
+                         m, algorithm="jax")
+    assert [r["valid?"] for r in rs] == [True, False, True, True, False,
+                                         False]
+    assert all(r["kernel"] == "dense-mask" for r in rs)
 
 
 def test_read_of_unreachable_value_dies():
