@@ -199,7 +199,7 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         ).reshape(M, S)
 
     def scan_step(carry, ev):
-        F, slot_f, slot_a, slot_b, slot_open, ok, val_of = carry
+        F, slot_f, slot_a, slot_b, slot_open, ok, dirty, val_of = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
@@ -210,6 +210,7 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         slot_a = jnp.where(upd, a, slot_a)
         slot_b = jnp.where(upd, b, slot_b)
         slot_open = jnp.where(upd, True, slot_open)
+        dirty = dirty | is_open
 
         def sweep(F):  # static unroll; expansions chain w ascending
             for w in range(W):
@@ -217,14 +218,20 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
                              slot_open)
             return F
 
-        F = _closure_fixpoint(W, sweep, F, is_force)
+        # Closure only when an OPEN happened since the last one: a closed
+        # frontier stays closed under FORCE kill+clear (extensions of a
+        # surviving config are supersets, so they survived and cleared
+        # too), so back-to-back completions skip the sweeps entirely.
+        F = _closure_fixpoint(W, sweep, F, is_force & dirty)
+        dirty = dirty & ~is_force
 
         slot_w = jnp.clip(slot, 0, W - 1)
         F_forced, alive = lax.switch(slot_w, force_branches, F)
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~(onehot & is_force)
-        return (F, slot_f, slot_a, slot_b, slot_open, ok, val_of), None
+        return (F, slot_f, slot_a, slot_b, slot_open, ok, dirty,
+                val_of), None
 
     def check(events, val_of):
         F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
@@ -232,7 +239,7 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
             F,
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
-            jnp.bool_(True), val_of,
+            jnp.bool_(True), jnp.bool_(False), val_of,
         )
         carry, _ = lax.scan(scan_step, carry, events)
         # The dense frontier cannot overflow: the array is the whole
@@ -273,8 +280,8 @@ def make_mask_dense_history_checker(model, n_slots: int):
                                axis=1).reshape(M, 1)
 
     def scan_step(carry, ev):
-        F, base, sums, slot_delta, slot_f, slot_a, slot_b, slot_open, ok = \
-            carry
+        (F, base, sums, slot_delta, slot_f, slot_a, slot_b, slot_open, ok,
+         dirty) = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
@@ -285,6 +292,7 @@ def make_mask_dense_history_checker(model, n_slots: int):
         slot_a = jnp.where(upd, a, slot_a)
         slot_b = jnp.where(upd, b, slot_b)
         slot_open = jnp.where(upd, True, slot_open)
+        dirty = dirty | is_open
         # Maintain sums[m] = Σ_w bit_w(m) · slot_delta[w] as slot w's
         # delta changes from its stale value to this op's.
         col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)  # [M]
@@ -299,7 +307,10 @@ def make_mask_dense_history_checker(model, n_slots: int):
                              slot_open)
             return F
 
-        F = _closure_fixpoint(W, sweep, F, is_force)
+        # Closure only when dirtied by an OPEN since the last closure
+        # (see the domain kernel's scan_step for why that is sound).
+        F = _closure_fixpoint(W, sweep, F, is_force & dirty)
+        dirty = dirty & ~is_force
 
         F_forced, alive = lax.switch(jnp.clip(slot, 0, W - 1),
                                      force_branches, F)
@@ -312,7 +323,7 @@ def make_mask_dense_history_checker(model, n_slots: int):
         slot_delta = jnp.where(onehot & is_force, 0, slot_delta)
         slot_open = slot_open & ~(onehot & is_force)
         return (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
-                slot_open, ok), None
+                slot_open, ok, dirty), None
 
     def check(events, val_of):
         del val_of  # calling-convention dummy (see docstring)
@@ -322,7 +333,7 @@ def make_mask_dense_history_checker(model, n_slots: int):
             jnp.zeros((M,), jnp.int32), jnp.zeros((W,), jnp.int32),
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
-            jnp.bool_(True),
+            jnp.bool_(True), jnp.bool_(False),
         )
         carry, _ = lax.scan(scan_step, carry, events)
         return carry[8], jnp.bool_(False)

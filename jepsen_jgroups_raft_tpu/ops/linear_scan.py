@@ -194,7 +194,8 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         return masks, states, overflow
 
     def scan_step(carry, ev):
-        masks, states, slot_f, slot_a, slot_b, slot_open, ok, overflow = carry
+        (masks, states, slot_f, slot_a, slot_b, slot_open, ok, overflow,
+         dirty) = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
@@ -205,10 +206,17 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         slot_a = jnp.where(upd, a, slot_a)
         slot_b = jnp.where(upd, b, slot_b)
         slot_open = jnp.where(upd, True, slot_open)
+        dirty = dirty | is_open
 
+        # Closure only when an OPEN happened since the last closure: a
+        # closed frontier stays closed under FORCE kill+clear (every
+        # extension of a surviving configuration is a superset, so it
+        # survived and cleared too) — back-to-back completions skip the
+        # expansion loop entirely.
         masks, states, overflow = closure(
             masks, states, overflow, slot_f, slot_a, slot_b,
-            slot_open, is_force)
+            slot_open, is_force & dirty)
+        dirty = dirty & ~is_force
 
         # FORCE: survivors have the slot's bit; then the bit is recycled.
         # Liveness guard matters: sentinel entries have every bit set and
@@ -232,7 +240,7 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         # duplicates, so no per-event re-dedup is needed — measured ~25%
         # of kernel time when it was).
         return (cleared_m, states, slot_f, slot_a, slot_b, slot_open,
-                ok, overflow), None
+                ok, overflow, dirty), None
 
     def check(events):
         masks = jnp.full((C, K), _SENT, dtype=jnp.uint32).at[0].set(
@@ -242,7 +250,7 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
             masks, states,
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
-            jnp.bool_(True), jnp.bool_(False),
+            jnp.bool_(True), jnp.bool_(False), jnp.bool_(False),
         )
         carry, _ = lax.scan(scan_step, carry, events)
         ok, overflow = carry[6], carry[7]
