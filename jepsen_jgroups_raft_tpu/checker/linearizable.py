@@ -133,8 +133,21 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None):
             batch = pack_batch([encs[i] for i in fits])
             ev, (val_of,), B = pad_batch_bucketed(batch["events"],
                                                   (plan.val_of,))
-            kernel = make_dense_batch_checker(model, plan.kind,
-                                              plan.n_slots, plan.n_states)
+            tag = plan.kernel_tag
+            if os.environ.get("JGRAFT_KERNEL") == "pallas" and \
+                    plan.kind == "domain":
+                # Opt-in Pallas path (ops/pallas_scan.py): same search,
+                # frontier pinned in VMEM. Interpret mode off-TPU.
+                import jax
+
+                from ..ops.pallas_scan import make_pallas_batch_checker
+                kernel = make_pallas_batch_checker(
+                    model, plan.n_slots, plan.n_states, ev.shape[1],
+                    interpret=jax.default_backend() != "tpu")
+                tag = "pallas"
+            else:
+                kernel = make_dense_batch_checker(
+                    model, plan.kind, plan.n_slots, plan.n_states)
             t0 = time.perf_counter()
             with _maybe_profile():
                 ok, _ = kernel(ev, val_of)
@@ -142,7 +155,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None):
             dt = time.perf_counter() - t0
             for j, i in enumerate(fits):
                 results[i] = _jx(VALID if ok[j] else INVALID, encs[i],
-                                 dt / len(fits), kernel=plan.kernel_tag)
+                                 dt / len(fits), kernel=tag)
             return results
 
         eff_slots = n_slots or bucket_slots(

@@ -1,0 +1,211 @@
+"""Pallas TPU kernel for the dense-bitset linearizability scan.
+
+The BASELINE.json north star names this shape explicitly: "the Knossos
+WGL/linear search … becomes a Pallas kernel operating on int32-encoded op
+histories resident in HBM, with the visited-configuration cache kept as
+an on-device bitset". This module is that kernel: the domain-mode dense
+frontier (ops/dense_scan.py) re-expressed as a `pl.pallas_call` where one
+grid program scans one history end-to-end with the frontier pinned in
+VMEM — no HBM round-trip of the scan carry between events, which is what
+the XLA `lax.scan` formulation pays.
+
+Mosaic-friendliness drives the formulation (everything is rank-2):
+
+  * The frontier F[2^W, S] lives as int32 0/1; OR is `maximum`, AND is
+    `*` — no bool arrays.
+  * The butterfly "configs without bit w flow to mask|bit_w" is a static
+    slice + concatenate SHIFT of the mask axis by 2^w rows, masked by
+    precomputed [M, 1] bit-column constants — no 4D reshapes, no
+    scatter/gather, no transposes.
+  * The per-slot transition matrix T[s, s'] = legal(s)·(step(s) == v_s')
+    needs the domain both as a column and as a row; both layouts are
+    passed from the host ([B, S, 1] and [B, 1, S] inputs) so the kernel
+    never transposes.
+  * Events are read per iteration with `pl.ds` dynamic row slices from
+    the program's [E, 5] VMEM block.
+
+Status: opt-in (`JGRAFT_KERNEL=pallas` routes eligible register batches
+here; see checker/linearizable.py) and validated against the XLA dense
+kernel and the CPU oracle by differential tests in interpret mode —
+hardware (Mosaic) validation runs on the first TPU-attached session via
+tests/test_pallas_scan.py::test_pallas_on_tpu_if_available.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..history.packing import EV_FORCE, EV_OPEN
+
+
+def _build_kernel(model, W: int, S: int, E: int):
+    """The kernel body, closed over static shapes and the model step."""
+    M = 1 << W
+
+    # Pallas kernels may not capture array constants, so the per-slot
+    # bit-column masks are derived in-kernel from an iota over mask ids.
+    def _bit_cols(w):
+        mask_ids = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)
+        has = (mask_ids >> w) & 1
+        return has, 1 - has
+
+    def expand_w(w, F, Ts):
+        """Configs without bit w linearize op w: transition every row
+        through T_w, keep rows with bit w clear, shift them onto their
+        mask|bit_w partner rows (m + 2^w), and OR in."""
+        d = 1 << w
+        _, no_col = _bit_cols(w)
+        stepped = jnp.dot(F.astype(jnp.float32), Ts[w],
+                          preferred_element_type=jnp.float32)
+        src = (stepped > 0.5).astype(jnp.int32) * no_col
+        shifted = jnp.concatenate(
+            [jnp.zeros((d, S), jnp.int32), src[:M - d]], axis=0)
+        return jnp.maximum(F, shifted)
+
+    def force_branch(w, F):
+        """Kill configs missing bit w, recycle the bit (shift back)."""
+        d = 1 << w
+        has_col, no_col = _bit_cols(w)
+        Fk = F * has_col
+        alive = jnp.sum(Fk) > 0
+        moved = jnp.concatenate(
+            [Fk[d:], jnp.zeros((d, S), jnp.int32)], axis=0) * no_col
+        return moved, alive
+
+    def kernel(events_ref, val_col_ref, val_row_ref, out_ref):
+        val_col = val_col_ref[0]  # [S, 1]
+        val_row = val_row_ref[0]  # [1, S]
+
+        def transition(w, slot_f, slot_a, slot_b, slot_open):
+            ns, legal = model.jax_step(val_col, slot_f[0, w], slot_a[0, w],
+                                       slot_b[0, w])  # [S, 1]
+            T = ((ns == val_row) & legal &
+                 (slot_open[0, w] > 0)).astype(jnp.float32)  # [S, S]
+            return T
+
+        def event_step(e, carry):
+            F, slot_f, slot_a, slot_b, slot_open, ok, dirty = carry
+            ev = events_ref[0, pl.ds(e, 1), :]  # [1, 5]
+            etype, slot = ev[0, 0], ev[0, 1]
+            f, a, b = ev[0, 2], ev[0, 3], ev[0, 4]
+            is_open = (etype == EV_OPEN).astype(jnp.int32)
+            is_force = (etype == EV_FORCE).astype(jnp.int32)
+
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+            upd = ((lane == slot) & (is_open > 0)).astype(jnp.int32)
+            slot_f = slot_f * (1 - upd) + f * upd
+            slot_a = slot_a * (1 - upd) + a * upd
+            slot_b = slot_b * (1 - upd) + b * upd
+            slot_open = jnp.maximum(slot_open, upd)
+            dirty = jnp.maximum(dirty, is_open)
+
+            Ts = [transition(w, slot_f, slot_a, slot_b, slot_open)
+                  for w in range(W)]
+
+            def sweep(F):
+                for w in range(W):
+                    F = expand_w(w, F, Ts)
+                return F
+
+            def closure_cond(c):
+                return c[0]
+
+            def closure_body(c):
+                _, it, F = c
+                F0 = F
+                F = sweep(F)
+                changed = jnp.sum(jnp.abs(F - F0)) > 0
+                return (changed & (it < W), it + 1, F)
+
+            _, _, F = lax.while_loop(
+                closure_cond, closure_body,
+                ((is_force * dirty) > 0, jnp.int32(0), F))
+            dirty = dirty * (1 - is_force)
+
+            slot_w = jnp.clip(slot, 0, W - 1)
+            F_forced, alive = lax.switch(
+                slot_w, [functools.partial(force_branch, w)
+                         for w in range(W)], F)
+            F = jnp.where(is_force > 0, F_forced, F)
+            ok = ok * jnp.where((is_force > 0) & ~alive, 0, 1)
+            slot_open = slot_open * (1 - ((lane == slot) & (is_force > 0))
+                                     .astype(jnp.int32))
+            return (F, slot_f, slot_a, slot_b, slot_open, ok, dirty)
+
+        F0 = jnp.zeros((M, S), jnp.int32)
+        # Initial config: empty mask, state id 0 (the initial value).
+        seed = ((jax.lax.broadcasted_iota(jnp.int32, (M, S), 0) == 0) &
+                (jax.lax.broadcasted_iota(jnp.int32, (M, S), 1) == 0)
+                ).astype(jnp.int32)
+        carry = (jnp.maximum(F0, seed),
+                 jnp.zeros((1, W), jnp.int32), jnp.zeros((1, W), jnp.int32),
+                 jnp.zeros((1, W), jnp.int32), jnp.zeros((1, W), jnp.int32),
+                 jnp.int32(1), jnp.int32(0))
+        carry = lax.fori_loop(0, E, event_step, carry)
+        out_ref[0, 0] = carry[5]
+
+    return kernel
+
+
+_CALL_CACHE: dict = {}
+
+
+def _build_call(model, W: int, S: int, E: int, interpret: bool):
+    # Same keying as the other kernel caches: (class, init_state) fully
+    # determines the kernel (jax_step is class-level code), so equivalent
+    # model instances share one Mosaic compile.
+    key = (type(model), int(model.init_state()), W, S, E, interpret)
+    cached = _CALL_CACHE.get(key)
+    if cached is not None:
+        return cached
+    kernel = _build_kernel(model, W, S, E)
+
+    def call(events, val_col, val_row):
+        B = events.shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, E, 5), lambda b: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, S, 1), lambda b: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, S), lambda b: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1), lambda b: (b, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B, 1), jnp.int32),
+            interpret=interpret,
+        )(events, val_col, val_row)
+
+    jitted = jax.jit(call)
+    _CALL_CACHE[key] = jitted
+    return jitted
+
+
+def make_pallas_batch_checker(model, n_slots: int, n_states: int,
+                              n_events: int, interpret: bool = False):
+    """fn(events [B,E,5] int32, val_of [B,S] int32) -> (valid[B] bool,
+    overflow[B] bool) — the dense-domain check as one Pallas launch, one
+    grid program per history. Like the dense kernel, overflow is
+    structurally impossible. `interpret` runs the Pallas interpreter
+    (CPU-correctness mode, used by the differential tests)."""
+    call = _build_call(model, int(n_slots), int(n_states), int(n_events),
+                       bool(interpret))
+
+    def check(events, val_of):
+        events = jnp.asarray(events, jnp.int32)
+        val_col = jnp.asarray(val_of, jnp.int32)[:, :, None]
+        val_row = jnp.asarray(val_of, jnp.int32)[:, None, :]
+        ok = call(events, val_col, val_row)[:, 0] > 0
+        return ok, jnp.zeros_like(ok)
+
+    return check

@@ -1,0 +1,109 @@
+"""Pallas dense-scan kernel: differential correctness.
+
+Interpret mode runs the kernel's exact dataflow on CPU; verdicts must
+match the XLA dense kernel and the unbounded CPU frontier on the same
+batches (goldens + randomized valid/corrupted histories). The hardware
+(Mosaic lowering) test runs only when a real TPU is attached.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
+from jepsen_jgroups_raft_tpu.history.ops import INFO, INVOKE, OK, History, Op
+from jepsen_jgroups_raft_tpu.history.packing import (encode_history,
+                                                     pack_batch,
+                                                     pad_batch_bucketed)
+from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+from jepsen_jgroups_raft_tpu.models.register import CasRegister
+from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plan
+from jepsen_jgroups_raft_tpu.ops.pallas_scan import make_pallas_batch_checker
+
+
+def _h(rows):
+    h = History()
+    for r in rows:
+        h.append(Op(*r))
+    return h
+
+
+def _run_pallas(encs, model, interpret=True):
+    plan = dense_plan(model, encs)
+    assert plan is not None and plan.kind == "domain"
+    ev, (val_of,), B = pad_batch_bucketed(pack_batch(encs)["events"],
+                                          (plan.val_of,))
+    kernel = make_pallas_batch_checker(model, plan.n_slots, plan.n_states,
+                                       ev.shape[1], interpret=interpret)
+    ok, overflow = kernel(ev, val_of)
+    return np.asarray(ok)[:B], np.asarray(overflow)[:B]
+
+
+def test_pallas_goldens_interpret():
+    m = CasRegister()
+    hists = [
+        _h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+            (1, INVOKE, "read", None), (1, OK, "read", 1)]),       # valid
+        _h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+            (1, INVOKE, "read", None), (1, OK, "read", 2)]),       # invalid
+        _h([(0, INVOKE, "write", 7), (0, INFO, "write", 7),
+            (1, INVOKE, "read", None), (1, OK, "read", 7)]),       # info ok
+        _h([(0, INVOKE, "cas", (0, 3)), (0, OK, "cas", (0, 3))]),  # cas≠init
+    ]
+    encs = [encode_history(h, m) for h in hists]
+    ok, overflow = _run_pallas(encs, m)
+    assert not overflow.any()
+    assert list(ok) == [True, False, True, False]
+
+
+def test_pallas_differential_vs_cpu_interpret():
+    m = CasRegister()
+    rng = random.Random(99)
+    encs = []
+    for i in range(24):
+        h = random_valid_history(rng, "register", n_ops=40, n_procs=4,
+                                 crash_p=0.15, max_crashes=3)
+        if i % 2:
+            ops = list(h)
+            reads = [j for j, op in enumerate(ops)
+                     if op.type == OK and op.f == "read"
+                     and op.value is not None]
+            if reads:
+                j = rng.choice(reads)
+                ops[j] = ops[j].replace(value=ops[j].value + 1)
+                h = ops
+        encs.append(encode_history(h, m))
+    ok, overflow = _run_pallas(encs, m)
+    assert not overflow.any()
+    for i, enc in enumerate(encs):
+        assert bool(ok[i]) is check_encoded_cpu(enc, m).valid, i
+
+
+def test_env_opt_in_routes_through_pallas(monkeypatch):
+    monkeypatch.setenv("JGRAFT_KERNEL", "pallas")
+    rs = check_histories(
+        [_h([(0, INVOKE, "write", 1), (0, OK, "write", 1),
+             (1, INVOKE, "read", None), (1, OK, "read", 1)])],
+        CasRegister(), algorithm="jax")
+    assert rs[0]["valid?"] is True
+    assert rs[0]["kernel"] == "pallas"  # routing really took the opt-in
+
+
+def test_pallas_on_tpu_if_available():
+    """Mosaic-lowering validation — only on a TPU-attached session
+    (JGRAFT_TPU_TESTS=1 opts in; the default test env pins CPU)."""
+    if os.environ.get("JGRAFT_TPU_TESTS") != "1":
+        pytest.skip("set JGRAFT_TPU_TESTS=1 on a TPU-attached session")
+    import jax
+    if jax.default_backend() != "tpu":
+        pytest.skip("no TPU attached")
+    m = CasRegister()
+    rng = random.Random(5)
+    encs = [encode_history(
+        random_valid_history(rng, "register", n_ops=50, n_procs=4,
+                             max_crashes=2), m) for _ in range(8)]
+    ok, overflow = _run_pallas(encs, m, interpret=False)
+    assert ok.all() and not overflow.any()
