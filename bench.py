@@ -89,23 +89,33 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         for _ in range(n_histories)
     ]
 
-    from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plan
+    from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plans_grouped
     from jepsen_jgroups_raft_tpu.ops.linear_scan import bucket_slots
 
     encs = [encode_history(h, model) for h in histories]
     n_slots = bucket_slots(max(e.n_slots for e in encs))
     mesh = make_mesh()
-    # Dense-bitset kernel when the workload's value domain allows it (the
-    # north-star register shape does); sort-kernel ladder otherwise.
-    plan = dense_plan(model, encs)
+    # Dense-bitset kernels when a history's value domain allows it (the
+    # north-star register shape does), grouped by concurrency window
+    # (kernel cost is exponential in W; a batch's windows spread with how
+    # many ops crashed per history); sort-kernel ladder for the rest.
+    grouped, rest = dense_plans_grouped(model, encs)
 
     def run():
         t0 = time.perf_counter()
         batch = pack_batch(encs)
         t1 = time.perf_counter()
-        ok, overflow, n_valid, n_unknown = check_batch_sharded(
-            model, batch["events"], mesh, n_slots=n_slots, dense=plan
-        )
+        n_valid = n_unknown = 0
+        for idxs, plan in grouped:
+            _, _, nv, nu = check_batch_sharded(
+                model, batch["events"][idxs], mesh, dense=plan)
+            n_valid += nv
+            n_unknown += nu
+        if rest:
+            _, _, nv, nu = check_batch_sharded(
+                model, batch["events"][rest], mesh, n_slots=n_slots)
+            n_valid += nv
+            n_unknown += nu
         t2 = time.perf_counter()
         return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
 
@@ -128,8 +138,12 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "n_histories": n_histories,
         "n_ops": n_ops,
         "n_procs": n_procs,
-        "kernel": "sort" if plan is None else plan.kernel_tag,
-        "concurrency_window": plan.n_slots if plan is not None else n_slots,
+        "kernel": sorted({p.kernel_tag for _, p in grouped} |
+                         ({"sort"} if rest else set())),
+        "concurrency_window": max(
+            [p.n_slots for _, p in grouped] + [n_slots if rest else 0]),
+        "window_groups": [[p.n_slots, len(ix)] for ix, p in grouped] +
+                         ([["sort", len(rest)]] if rest else []),
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),

@@ -40,7 +40,7 @@ import numpy as np
 from ..history.ops import History
 from ..history.packing import (EncodedHistory, encode_history, pack_batch,
                                pad_batch_bucketed)
-from ..ops.dense_scan import dense_plan, make_dense_batch_checker
+from ..ops.dense_scan import dense_plans_grouped, make_dense_batch_checker
 from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
                                make_batch_checker)
 from .base import Checker, INVALID, UNKNOWN, VALID
@@ -127,37 +127,42 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None):
         # own workloads produce. Pinned n_configs/n_slots are sort-kernel
         # knobs, so an explicit pin keeps the sort path (tests rely on
         # capacity semantics).
-        plan = (dense_plan(model, [encs[i] for i in fits])
-                if n_configs is None and n_slots is None else None)
-        if plan is not None:
-            batch = pack_batch([encs[i] for i in fits])
-            ev, (val_of,), B = pad_batch_bucketed(batch["events"],
-                                                  (plan.val_of,))
-            tag = plan.kernel_tag
-            if os.environ.get("JGRAFT_KERNEL") == "pallas" and \
-                    plan.kind == "domain":
-                # Opt-in Pallas path (ops/pallas_scan.py): same search,
-                # frontier pinned in VMEM. Interpret mode off-TPU.
-                import jax
+        grouped, rest = (dense_plans_grouped(model,
+                                             [encs[i] for i in fits])
+                         if n_configs is None and n_slots is None
+                         else ([], list(range(len(fits)))))
+        if grouped:
+            for idxs, plan in grouped:
+                sub = [fits[j] for j in idxs]
+                batch = pack_batch([encs[i] for i in sub])
+                ev, (val_of,), B = pad_batch_bucketed(batch["events"],
+                                                      (plan.val_of,))
+                tag = plan.kernel_tag
+                if os.environ.get("JGRAFT_KERNEL") == "pallas" and \
+                        plan.kind == "domain":
+                    # Opt-in Pallas path (ops/pallas_scan.py): same
+                    # search, frontier pinned in VMEM. Interpret off-TPU.
+                    import jax
 
-                from ..ops.pallas_scan import make_pallas_batch_checker
-                kernel = make_pallas_batch_checker(
-                    model, plan.n_slots, plan.n_states, ev.shape[1],
-                    interpret=jax.default_backend() != "tpu")
-                tag = "pallas"
-            else:
-                kernel = make_dense_batch_checker(
-                    model, plan.kind, plan.n_slots, plan.n_states)
-            t0 = time.perf_counter()
-            with _maybe_profile():
-                ok, _ = kernel(ev, val_of)
-            ok = np.asarray(ok)[:B]
-            dt = time.perf_counter() - t0
-            for j, i in enumerate(fits):
-                results[i] = _jx(VALID if ok[j] else INVALID, encs[i],
-                                 dt / len(fits), kernel=tag)
-            return results
-
+                    from ..ops.pallas_scan import make_pallas_batch_checker
+                    kernel = make_pallas_batch_checker(
+                        model, plan.n_slots, plan.n_states, ev.shape[1],
+                        interpret=jax.default_backend() != "tpu")
+                    tag = "pallas"
+                else:
+                    kernel = make_dense_batch_checker(
+                        model, plan.kind, plan.n_slots, plan.n_states)
+                t0 = time.perf_counter()
+                with _maybe_profile():
+                    ok, _ = kernel(ev, val_of)
+                ok = np.asarray(ok)[:B]
+                dt = time.perf_counter() - t0
+                for j, i in enumerate(sub):
+                    results[i] = _jx(VALID if ok[j] else INVALID, encs[i],
+                                     dt / len(sub), kernel=tag)
+        # Histories beyond the dense caps continue to the sort ladder.
+        fits = [fits[j] for j in rest]
+    if fits:
         eff_slots = n_slots or bucket_slots(
             max(encs[i].n_slots for i in fits)
         )

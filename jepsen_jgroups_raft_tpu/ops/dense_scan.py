@@ -106,24 +106,83 @@ def dense_plan(model, encs: Sequence[EncodedHistory]) -> Optional[DensePlan]:
         S = max((len(d) for d in domains), default=1)
         if W <= DENSE_MAX_SLOTS and S <= DENSE_MAX_STATES and \
                 (1 << W) * S <= DENSE_MAX_CELLS:
-            # Bucket S to a power of two: domain sizes drift batch to
-            # batch (new values appear) and each (W, S) pair is a fresh
+            # S buckets to a power of two inside _pad_domains: domain
+            # sizes drift batch to batch and each (W, S) pair is a fresh
             # XLA compile; padding states is cheap (S² sits in a tiny
             # matmul), stable shapes are not. W stays exact — its cost
             # is exponential.
-            S_b = 1
-            while S_b < S:
-                S_b *= 2
-            S = S_b
-            val_of = np.empty((len(domains), S), dtype=np.int32)
-            for i, d in enumerate(domains):
-                val_of[i, : len(d)] = d
-                val_of[i, len(d):] = d[0]
-            return DensePlan("domain", max(W, 1), S, val_of)
+            S_b, val_of = _pad_domains(domains, range(len(domains)))
+            return DensePlan("domain", max(W, 1), S_b, val_of)
     if model.mask_determined and W <= MASK_DENSE_MAX_SLOTS:
         dummy = np.zeros((len(encs), 1), dtype=np.int32)
         return DensePlan("mask", max(W, 1), 1, dummy)
     return None
+
+
+#: Don't launch a dense kernel for fewer histories than this — merge the
+#: stragglers into the next-wider window group instead (launch + compile
+#: amortization beats a snugger W for tiny groups).
+DENSE_MIN_GROUP = 16
+
+
+def _pad_domains(domains, idxs):
+    """[len(idxs), S] id→value table from per-history domains, S bucketed
+    to a power of two (stable compile shapes), rows padded with their own
+    id-0 (initial) value."""
+    ds = [domains[i] for i in idxs]
+    S = max(len(d) for d in ds)
+    S_b = 1
+    while S_b < S:
+        S_b *= 2
+    val_of = np.empty((len(ds), S_b), dtype=np.int32)
+    for r, d in enumerate(ds):
+        val_of[r, : len(d)] = d
+        val_of[r, len(d):] = d[0]
+    return S_b, val_of
+
+
+def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
+    """Route each history of a batch to its cheapest dense kernel.
+
+    Returns (groups, rest): `groups` is [(indices, DensePlan)] over the
+    dense-eligible histories, partitioned by kernel kind and concurrency
+    window — kernel cost is exponential in W and a real batch's windows
+    spread with per-history crash counts (the north-star batch measures
+    W=5..8), so snug per-group windows beat one batch-max kernel ~1.7×.
+    `rest` holds the indices that need the sort-kernel ladder (window or
+    domain beyond the dense caps); eligibility is per history, so one
+    oversized history no longer drags the whole batch off the dense path.
+    Each history's domain is scanned exactly once."""
+    domains = [model.dense_domain(e.events) for e in encs]
+    buckets: dict = {}
+    rest: list = []
+    for i, (e, d) in enumerate(zip(encs, domains)):
+        W = max(e.n_slots, 1)
+        if d is not None and W <= DENSE_MAX_SLOTS and \
+                len(d) <= DENSE_MAX_STATES and \
+                (1 << W) * len(d) <= DENSE_MAX_CELLS:
+            buckets.setdefault(("domain", W), []).append(i)
+        elif model.mask_determined and W <= MASK_DENSE_MAX_SLOTS:
+            buckets.setdefault(("mask", W), []).append(i)
+        else:
+            rest.append(i)
+    groups: list = []
+    for kind in ("domain", "mask"):
+        windows = sorted(w for k, w in buckets if k == kind)
+        pending: list = []
+        for w in windows:
+            pending += buckets[(kind, w)]
+            if len(pending) >= DENSE_MIN_GROUP or w == windows[-1]:
+                if kind == "domain":
+                    S, val_of = _pad_domains(domains, pending)
+                    plan = DensePlan("domain", w, S, val_of)
+                else:
+                    plan = DensePlan(
+                        "mask", w, 1,
+                        np.zeros((len(pending), 1), dtype=np.int32))
+                groups.append((pending, plan))
+                pending = []
+    return groups, rest
 
 
 def _bit_table(M: int, W: int) -> np.ndarray:
