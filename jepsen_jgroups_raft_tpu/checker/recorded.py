@@ -6,8 +6,10 @@ synthetic histories, but histories a cluster actually produced, loaded
 back from the store and verified together. This module is that path:
 
   store/<name>/<ts>/history.jsonl  →  load  →  per-key split (independent
-  workloads, reference register.clj:106)  →  ONE vmapped kernel batch
-  across every sub-history of every run  →  per-run verdicts.
+  workloads, reference register.clj:106)  →  one vmapped kernel batch per
+  model family across every sub-history of every run  →  per-run
+  verdicts. (Invariant-style checkers without the Model step interface —
+  the election-safety LeaderModel — run their direct host check instead.)
 
 Exposed on the CLI as `python -m jepsen_jgroups_raft_tpu check RUN_DIR…` —
 re-analysis of stored runs, a capability the reference reaches by re-running
@@ -23,6 +25,7 @@ from typing import Optional, Sequence
 
 from ..history.ops import History
 from ..models import CasRegister, Counter, LeaderModel
+from ..models.base import Model
 from .base import INVALID, UNKNOWN, VALID, merge_valid
 from .independent import split_by_key
 from .linearizable import check_histories
@@ -68,9 +71,10 @@ def load_run_histories(run_dir, workload: Optional[str] = None):
 def check_recorded(run_dirs: Sequence, workload: Optional[str] = None,
                    algorithm: str = "auto",
                    n_configs: Optional[int] = None) -> dict:
-    """Batch-verify recorded runs. All sub-histories across all runs go
-    through ONE check_histories batch (one model per call — mixed-workload
-    runs are grouped by model). Returns a summary dict with per-run
+    """Batch-verify recorded runs. Sub-histories across all runs are
+    grouped by model family; each frontier-model group goes through one
+    check_histories batch, non-Model invariant checkers (LeaderModel) run
+    their direct check per history. Returns a summary dict with per-run
     verdicts and throughput."""
     loaded = []  # (run_dir, model, subs)
     for d in run_dirs:
@@ -90,8 +94,15 @@ def check_recorded(run_dirs: Sequence, workload: Optional[str] = None,
         if not hists:
             continue
         n_histories += len(hists)
-        results = check_histories(hists, model, algorithm=algorithm,
-                                  n_configs=n_configs)
+        if not isinstance(model, Model):
+            # Not a frontier-search model: invariant checkers like
+            # LeaderModel (election safety is order-independent) expose a
+            # direct `check(history)` instead of the Model step interface
+            # (models/leader.py), matching the live checker's routing.
+            results = [model.check(h) for h in hists]
+        else:
+            results = check_histories(hists, model, algorithm=algorithm,
+                                      n_configs=n_configs)
         for (d, _), r in zip(tagged, results):
             per_run[d].append(r)
     dt = time.perf_counter() - t0

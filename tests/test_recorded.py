@@ -47,6 +47,18 @@ def test_recorded_runs_reverify_as_device_batch(tmp_path, capsys):
     assert parsed["histories"] == summary["histories"]
 
 
+def test_recorded_election_run_reverifies(tmp_path):
+    """Election stores route through LeaderModel's direct check (it is not
+    a frontier-search model — recheck used to crash on such stores)."""
+    t = run_native_test(tmp_path, "election", "election", "partition",
+                        seed=23)
+    assert t["results"]["valid?"] is True
+    summary = check_recorded([t["store_dir"]], algorithm="auto")
+    assert summary["valid?"] is True
+    assert summary["n-invalid"] == 0
+    assert summary["n-unknown"] == 0
+
+
 def test_recorded_check_flags_corruption(tmp_path):
     """A tampered recorded history must turn the re-verification invalid —
     the checker is reading the real bytes, not trusting results.json."""
