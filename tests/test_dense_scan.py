@@ -230,6 +230,55 @@ def test_read_of_unreachable_value_dies():
     assert rs[0]["valid?"] is False
 
 
+@pytest.mark.parametrize("model_kind", ["register", "counter"])
+def test_all_engines_agree_on_one_corpus(model_kind, monkeypatch):
+    """Every engine, one corpus: brute-force oracle == CPU frontier ==
+    DFS == sort kernel == dense/dense-mask kernel (== Pallas interpret
+    for the register) on the same randomized valid+corrupted histories.
+    The strongest cross-check in the suite: any single-engine regression
+    breaks a direct equality against the exponential oracle."""
+    from jepsen_jgroups_raft_tpu.checker.brute import check_brute
+    from jepsen_jgroups_raft_tpu.checker.dfs_cpu import check_encoded_dfs
+    from jepsen_jgroups_raft_tpu.history.synth import corrupt
+
+    model = CasRegister() if model_kind == "register" else Counter()
+    rng = random.Random(1234)
+    cases = []
+    for trial in range(60):
+        h = random_valid_history(rng, model_kind, n_ops=8, n_procs=3)
+        if trial % 2:
+            h = corrupt(rng, h)
+        cases.append(h)
+    encs = [encode_history(h, model) for h in cases]
+    expected = [check_brute(h, model) for h in cases]
+
+    # dense / dense-mask via the auto route
+    dense_rs = check_histories(cases, model, algorithm="jax")
+    for i, r in enumerate(dense_rs):
+        got = r["valid?"] is True
+        assert got == expected[i], f"dense case {i}"
+        if encs[i].n_events:
+            assert r["kernel"].startswith("dense"), r
+
+    # sort kernel (pinned capacity forces it)
+    sort_rs = check_histories(cases, model, algorithm="jax", n_configs=128)
+    for i, r in enumerate(sort_rs):
+        assert (r["valid?"] is True) == expected[i], f"sort case {i}"
+
+    # host engines
+    for i, e in enumerate(encs):
+        if e.n_events == 0:
+            continue
+        assert check_encoded_cpu(e, model).valid == expected[i], i
+        assert check_encoded_dfs(e, model).valid == expected[i], i
+
+    if model_kind == "register":  # Pallas (interpret) on the same corpus
+        monkeypatch.setenv("JGRAFT_KERNEL", "pallas")
+        pl_rs = check_histories(cases, model, algorithm="jax")
+        for i, r in enumerate(pl_rs):
+            assert (r["valid?"] is True) == expected[i], f"pallas case {i}"
+
+
 def test_pinned_capacity_keeps_sort_kernel():
     """Explicit n_configs is a sort-kernel knob: pinning it must bypass
     the dense path (capacity-escalation tests depend on it)."""
