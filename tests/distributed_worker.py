@@ -1,0 +1,79 @@
+"""Worker process for the multi-host distributed-checker test.
+
+Launched by tests/test_distributed.py with the standard JAX cluster env
+(JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) and 4
+virtual CPU devices per process. Every process builds the same 16-history
+batch, contributes its process-local shard of the global array, runs the
+sharded dense checker over the GLOBAL 8-device mesh, and asserts the
+psum-aggregated verdict count — the cross-process collective is the
+actual thing under test (the DCN path of SURVEY.md §5.8).
+"""
+
+import os
+import random
+import sys
+
+from jepsen_jgroups_raft_tpu.platform import pin_cpu
+
+pin_cpu(4)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from jepsen_jgroups_raft_tpu.history.packing import (  # noqa: E402
+    encode_history, pack_batch)
+from jepsen_jgroups_raft_tpu.history.synth import (  # noqa: E402
+    random_valid_history)
+from jepsen_jgroups_raft_tpu.models.register import CasRegister  # noqa: E402
+from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plan  # noqa: E402
+from jepsen_jgroups_raft_tpu.parallel.distributed import (  # noqa: E402
+    maybe_init_distributed)
+from jepsen_jgroups_raft_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh, sharded_dense_checker)
+
+
+def main() -> int:
+    assert maybe_init_distributed(), "cluster env missing"
+    nproc = int(os.environ["JAX_NUM_PROCESSES"])
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.local_devices()) == 4
+    n_global = jax.device_count()
+    assert n_global == 4 * nproc, n_global
+
+    B = 2 * n_global
+    rng = random.Random(7)
+    model = CasRegister()
+    encs = [encode_history(
+        random_valid_history(rng, "register", n_ops=30, n_procs=4,
+                             max_crashes=2), model) for _ in range(B)]
+    plan = dense_plan(model, encs)
+    assert plan is not None
+    events = pack_batch(encs)["events"]
+
+    mesh = make_mesh()  # all global devices
+    axis = mesh.axis_names[0]
+    ev_sharding = NamedSharding(mesh, P(axis, None, None))
+    val_sharding = NamedSharding(mesh, P(axis, None))
+    # Each process contributes the rows its local devices own.
+    pid = jax.process_index()
+    rows_per_proc = B // nproc
+    lo, hi = pid * rows_per_proc, (pid + 1) * rows_per_proc
+    g_events = jax.make_array_from_process_local_data(
+        ev_sharding, np.ascontiguousarray(events[lo:hi]))
+    g_val = jax.make_array_from_process_local_data(
+        val_sharding, np.ascontiguousarray(plan.val_of[lo:hi]))
+
+    fn = sharded_dense_checker(model, mesh, plan.kind, plan.n_slots,
+                               plan.n_states)
+    ok, overflow, n_valid, n_unknown = fn(g_events, g_val)
+    # n_valid is a psum across the whole mesh — every process must see the
+    # full global count even though it only fed its local shard.
+    assert int(n_valid) == B, (pid, int(n_valid))
+    assert int(n_unknown) == 0
+    print(f"proc {pid}: global n_valid={int(n_valid)} of {B} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

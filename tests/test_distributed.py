@@ -1,0 +1,59 @@
+"""Multi-host distributed checking: 2 real processes, one global mesh.
+
+The reference scales across hosts with JGroups (SURVEY.md §5.8); the
+checker backend's analogue is `jax.distributed` — one process per host,
+every process's devices in one global mesh, verdict psums riding the
+cross-process (DCN) transport. This test runs that for real: two OS
+processes with 4 virtual CPU devices each coordinate over localhost
+gRPC, shard one 16-history batch, and each must observe the globally
+psum-aggregated verdict count.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from util import free_port
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_two_process_global_mesh_psum():
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        # The worker pins its own platform/device count (pin_cpu(4));
+        # an inherited XLA_FLAGS device count would override it (pin_cpu
+        # only ever raises the count), so drop it.
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+            "PYTHONPATH": f"{REPO}:{env.get('PYTHONPATH', '')}",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "distributed_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                # Keep the failure diagnosable: kill, then drain output.
+                p.kill()
+                out, _ = p.communicate()
+                out += "\n[worker timed out after 300s]"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid}: global n_valid=16 of 16 OK" in out, out[-1000:]

@@ -63,13 +63,7 @@ def shimmed_path(tmp_path, monkeypatch):
     return shim_dir
 
 
-def _free_port():
-    import socket
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from util import free_port as _free_port  # noqa: E402  (shared helper)
 
 
 def test_ssh_tier_full_lifecycle_executes(tmp_path, shimmed_path):

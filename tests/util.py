@@ -12,3 +12,15 @@ from jepsen_jgroups_raft_tpu.history.synth import (  # noqa: F401
 
 def H(*rows):
     return build_history(rows)
+
+
+def free_port() -> int:
+    """An ephemeral localhost port (shared helper; also mirrored by the
+    deploy tier's internal _free_port)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
