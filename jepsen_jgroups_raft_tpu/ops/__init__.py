@@ -1,11 +1,23 @@
 """On-device kernels: the TPU execution backend for history verification.
 
 This package is the equivalent of knossos' search engine (the reference's
-L0 "compute kernel", SURVEY.md §3.4), re-designed for XLA/TPU: fixed-shape
-frontier expansion under lax.scan/while_loop, sort-based deduplication,
-vmap over batches of independent histories.
+L0 "compute kernel", SURVEY.md §3.4), re-designed for XLA/TPU. Three
+kernel families behind one routing layer (doc/checker-design.md):
+
+* `dense_scan`  — dense-bitset frontiers for small enumerable domains
+  (register) and order-independent models (counter, mask mode); exact,
+  overflow-free.
+* `linear_scan` — the general sort-dedup frontier scan (windows ≤127).
+* `pallas_scan` — the dense scan as a Pallas kernel, frontier in VMEM
+  (opt-in via JGRAFT_KERNEL=pallas).
 """
 
+from .dense_scan import (  # noqa: F401
+    DensePlan,
+    dense_plan,
+    dense_plans_grouped,
+    make_dense_batch_checker,
+)
 from .linear_scan import (  # noqa: F401
     make_batch_checker,
     make_history_checker,
