@@ -60,6 +60,16 @@ class Model:
     def init_state(self) -> int:
         raise NotImplementedError
 
+    def cache_key(self) -> tuple:
+        """Hashable identity of this model's compiled-kernel semantics.
+        Every kernel cache (ops/dense_scan, ops/pallas_scan,
+        ops/linear_scan, parallel/mesh) keys on it. The default assumes a
+        model is fully determined by its class + initial state; a subclass
+        whose `jax_step`/`mask_delta` depends on extra constructor
+        parameters MUST extend the tuple, or equivalent-looking models
+        would silently share one stale compiled kernel."""
+        return (type(self), int(self.init_state()))
+
     def step(self, state: int, f: int, a: int, b: int) -> Tuple[int, bool]:
         """Pure python step: (state, op) -> (state', legal). Must agree
         exactly with `jax_step` — the differential tests pin this."""

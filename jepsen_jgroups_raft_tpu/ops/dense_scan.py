@@ -94,6 +94,8 @@ def dense_plan(model, encs: Sequence[EncodedHistory]) -> Optional[DensePlan]:
     first, mask mode second), or None → the general sort kernel. The
     kernel shape is the batch maximum; domain tables are padded with
     their own id-0 (initial) value."""
+    if not encs:  # nothing to plan — and _pad_domains would max() over []
+        return None
     W = max((e.n_slots for e in encs), default=0)
     domains = []
     for e in encs:
@@ -175,7 +177,26 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
             if len(pending) >= DENSE_MIN_GROUP or w == windows[-1]:
                 if kind == "domain":
                     S, val_of = _pad_domains(domains, pending)
-                    plan = DensePlan("domain", w, S, val_of)
+                    # Flush-time envelope re-check: eligibility above used
+                    # each history's own W and unpadded |domain|, but the
+                    # merged group launches at the widest W with S bucketed
+                    # up to a power of two — which can exceed the cell cap
+                    # (e.g. stragglers merged into a 2^10 window with S
+                    # padded 9→16 = 16384 cells, 2× the cap). Shed the
+                    # widest histories to the sort ladder rather than
+                    # launch an oversized kernel.
+                    w_eff = max(max(encs[i].n_slots for i in pending), 1)
+                    while (1 << w_eff) * S > DENSE_MAX_CELLS and pending:
+                        widest = max(pending, key=lambda i: encs[i].n_slots)
+                        pending.remove(widest)
+                        rest.append(widest)
+                        if pending:
+                            S, val_of = _pad_domains(domains, pending)
+                            w_eff = max(max(encs[i].n_slots
+                                            for i in pending), 1)
+                    if not pending:
+                        continue
+                    plan = DensePlan("domain", w_eff, S, val_of)
                 else:
                     plan = DensePlan(
                         "mask", w, 1,
@@ -414,8 +435,7 @@ _KERNEL_CACHE: dict = {}
 def make_dense_batch_checker(model, kind: str, n_slots: int, n_states: int,
                              jit: bool = True):
     """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B])."""
-    key = (type(model), model.init_state(), kind, int(n_slots),
-           int(n_states), jit)
+    key = (*model.cache_key(), kind, int(n_slots), int(n_states), jit)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         single = make_dense_single_checker(model, kind, n_slots, n_states)

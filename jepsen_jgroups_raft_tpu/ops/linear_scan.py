@@ -281,10 +281,9 @@ def make_batch_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
 
     Kernels are cached by (model identity, C, W): jax.jit caches traces per
     function object, so handing it a fresh closure per call would recompile
-    every time. Model identity = (class, init_state), which fully determines
-    the kernel — jax_step is class-level code.
+    every time. Model identity = `Model.cache_key()`.
     """
-    key = (type(model), model.init_state(), int(n_configs), int(n_slots), jit)
+    key = (*model.cache_key(), int(n_configs), int(n_slots), jit)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         single = make_history_checker(model, n_configs, n_slots)

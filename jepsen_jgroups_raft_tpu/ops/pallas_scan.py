@@ -149,7 +149,12 @@ def _build_kernel(model, W: int, S: int, E: int):
                  jnp.zeros((1, W), jnp.int32), jnp.zeros((1, W), jnp.int32),
                  jnp.int32(1), jnp.int32(0))
         carry = lax.fori_loop(0, E, event_step, carry)
-        out_ref[0, 0] = carry[5]
+        # Scalar verdict goes out through SMEM: Mosaic rejects scalar
+        # stores to VMEM, and this jax version applies the "block tiles to
+        # (8, 128) or spans the array" rule to every memory space — so the
+        # SMEM block spans the whole [B, 1] array and each grid program
+        # scalar-stores its own row (the TPU grid is sequential: no race).
+        out_ref[pl.program_id(0), 0] = carry[5]
 
     return kernel
 
@@ -158,10 +163,9 @@ _CALL_CACHE: dict = {}
 
 
 def _build_call(model, W: int, S: int, E: int, interpret: bool):
-    # Same keying as the other kernel caches: (class, init_state) fully
-    # determines the kernel (jax_step is class-level code), so equivalent
+    # Same keying as the other kernel caches (Model.cache_key): equivalent
     # model instances share one Mosaic compile.
-    key = (type(model), int(model.init_state()), W, S, E, interpret)
+    key = (*model.cache_key(), W, S, E, interpret)
     cached = _CALL_CACHE.get(key)
     if cached is not None:
         return cached
@@ -180,8 +184,8 @@ def _build_call(model, W: int, S: int, E: int, interpret: bool):
                 pl.BlockSpec((1, 1, S), lambda b: (b, 0, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((1, 1), lambda b: (b, 0),
-                                   memory_space=pltpu.VMEM),
+            out_specs=pl.BlockSpec((B, 1), lambda b: (0, 0),
+                                   memory_space=pltpu.SMEM),
             out_shape=jax.ShapeDtypeStruct((B, 1), jnp.int32),
             interpret=interpret,
         )(events, val_col, val_row)

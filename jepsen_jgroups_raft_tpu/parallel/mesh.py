@@ -55,13 +55,16 @@ def sharded_batch_checker(model, mesh: Mesh,
                           n_configs: int = DEFAULT_N_CONFIGS,
                           n_slots: int = MAX_SLOTS,
                           axis_name: str = BATCH_AXIS):
-    """Build fn(events:[B,E,5]) -> (ok[B], overflow[B], n_valid, n_unknown).
+    """Build fn(events:[B,E,5], real:[B] bool) ->
+    (ok[B], overflow[B], n_valid, n_unknown).
 
     B must be a multiple of the mesh size (use `check_batch_sharded` for
     automatic padding). ok/overflow stay sharded over the batch axis;
     n_valid/n_unknown are scalar `psum` aggregates (the ICI collective).
+    `real` masks padding rows out of the aggregates — EV_PAD histories are
+    trivially valid, so counting them would silently inflate n_valid.
     """
-    key = (type(model), model.init_state(), int(n_configs), int(n_slots),
+    key = (*model.cache_key(), int(n_configs), int(n_slots),
            tuple(mesh.devices.flat), axis_name)
     fn = _CACHE.get(key)
     if fn is not None:
@@ -70,10 +73,10 @@ def sharded_batch_checker(model, mesh: Mesh,
     single = make_history_checker(model, n_configs, n_slots)
     vm = jax.vmap(single)
 
-    def local_step(ev):  # ev: [B/n, E, 5] local shard
+    def local_step(ev, real):  # ev: [B/n, E, 5] local shard
         ok, overflow = vm(ev)
-        n_valid = jax.lax.psum(jnp.sum(ok & ~overflow), axis_name)
-        n_unknown = jax.lax.psum(jnp.sum(overflow), axis_name)
+        n_valid = jax.lax.psum(jnp.sum(ok & ~overflow & real), axis_name)
+        n_unknown = jax.lax.psum(jnp.sum(overflow & real), axis_name)
         return ok, overflow, n_valid, n_unknown
 
     # check_vma=False: the scan carry inside the kernel starts from
@@ -82,7 +85,7 @@ def sharded_batch_checker(model, mesh: Mesh,
     mapped = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=P(axis_name),
+        in_specs=(P(axis_name), P(axis_name)),
         out_specs=(P(axis_name), P(axis_name), P(), P()),
         check_vma=False,
     )
@@ -94,10 +97,11 @@ def sharded_batch_checker(model, mesh: Mesh,
 def sharded_dense_checker(model, mesh: Mesh, kind: str, n_slots: int,
                           n_states: int, axis_name: str = BATCH_AXIS):
     """Dense-bitset variant of `sharded_batch_checker`:
-    fn(events [B,E,5], val_of [B,S]) -> (ok[B], overflow[B], n_valid,
-    n_unknown). Same mesh layout; the per-history domain table (or the
-    mask-mode dummy) shards with the batch."""
-    key = ("dense", kind, type(model), model.init_state(), int(n_slots),
+    fn(events [B,E,5], val_of [B,S], real [B] bool) -> (ok[B],
+    overflow[B], n_valid, n_unknown). Same mesh layout; the per-history
+    domain table (or the mask-mode dummy) and the padding mask shard with
+    the batch."""
+    key = ("dense", kind, *model.cache_key(), int(n_slots),
            int(n_states), tuple(mesh.devices.flat), axis_name)
     fn = _CACHE.get(key)
     if fn is not None:
@@ -105,22 +109,29 @@ def sharded_dense_checker(model, mesh: Mesh, kind: str, n_slots: int,
 
     vm = jax.vmap(make_dense_single_checker(model, kind, n_slots, n_states))
 
-    def local_step(ev, val_of):
+    def local_step(ev, val_of, real):
         ok, overflow = vm(ev, val_of)
-        n_valid = jax.lax.psum(jnp.sum(ok), axis_name)
-        n_unknown = jax.lax.psum(jnp.sum(overflow), axis_name)
+        n_valid = jax.lax.psum(jnp.sum(ok & real), axis_name)
+        n_unknown = jax.lax.psum(jnp.sum(overflow & real), axis_name)
         return ok, overflow, n_valid, n_unknown
 
     mapped = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name)),
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P(axis_name), P(axis_name), P(), P()),
         check_vma=False,
     )
     fn = jax.jit(mapped)
     _CACHE[key] = fn
     return fn
+
+
+def _real_mask(B_real: int, B_padded: int) -> np.ndarray:
+    """[B_padded] bool: True for real rows, False for EV_PAD padding."""
+    mask = np.zeros((B_padded,), dtype=bool)
+    mask[:B_real] = True
+    return mask
 
 
 
@@ -134,9 +145,11 @@ def _run_once(model, events: np.ndarray, mesh: Mesh, n_configs: int,
     events, _, B = pad_batch_bucketed(events, floor_e=None,
                                       multiple_b=mesh.devices.size)
     sharding = NamedSharding(mesh, P(axis_name, None, None))
+    msharding = NamedSharding(mesh, P(axis_name))
     dev_events = jax.device_put(events, sharding)
+    dev_mask = jax.device_put(_real_mask(B, events.shape[0]), msharding)
     fn = sharded_batch_checker(model, mesh, n_configs, n_slots, axis_name)
-    ok, overflow, _, _ = fn(dev_events)
+    ok, overflow, _, _ = fn(dev_events, dev_mask)
     return np.asarray(ok)[:B], np.asarray(overflow)[:B]
 
 
@@ -169,12 +182,15 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
             multiple_b=mesh.devices.size)
         sharding = NamedSharding(mesh, P(axis_name, None, None))
         vsharding = NamedSharding(mesh, P(axis_name, None))
+        msharding = NamedSharding(mesh, P(axis_name))
         fn = sharded_dense_checker(model, mesh, dense.kind, dense.n_slots,
                                    dense.n_states, axis_name)
-        ok, overflow, _, _ = fn(jax.device_put(events, sharding),
-                                jax.device_put(val_of, vsharding))
+        mask = _real_mask(B, events.shape[0])
+        ok, overflow, n_valid, _ = fn(jax.device_put(events, sharding),
+                                      jax.device_put(val_of, vsharding),
+                                      jax.device_put(mask, msharding))
         ok = np.asarray(ok)[:B]
-        return ok, np.zeros((B,), bool), int(np.sum(ok)), 0
+        return ok, np.zeros((B,), bool), int(n_valid), 0
     ladder = ([n_configs] if n_configs else
               [64, DEFAULT_N_CONFIGS] if DEFAULT_N_CONFIGS > 64
               else [DEFAULT_N_CONFIGS])

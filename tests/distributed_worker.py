@@ -55,6 +55,7 @@ def main() -> int:
     axis = mesh.axis_names[0]
     ev_sharding = NamedSharding(mesh, P(axis, None, None))
     val_sharding = NamedSharding(mesh, P(axis, None))
+    mask_sharding = NamedSharding(mesh, P(axis))
     # Each process contributes the rows its local devices own.
     pid = jax.process_index()
     rows_per_proc = B // nproc
@@ -63,10 +64,12 @@ def main() -> int:
         ev_sharding, np.ascontiguousarray(events[lo:hi]))
     g_val = jax.make_array_from_process_local_data(
         val_sharding, np.ascontiguousarray(plan.val_of[lo:hi]))
+    g_mask = jax.make_array_from_process_local_data(
+        mask_sharding, np.ones((hi - lo,), dtype=bool))
 
     fn = sharded_dense_checker(model, mesh, plan.kind, plan.n_slots,
                                plan.n_states)
-    ok, overflow, n_valid, n_unknown = fn(g_events, g_val)
+    ok, overflow, n_valid, n_unknown = fn(g_events, g_val, g_mask)
     # n_valid is a psum across the whole mesh — every process must see the
     # full global count even though it only fed its local shard.
     assert int(n_valid) == B, (pid, int(n_valid))
