@@ -51,6 +51,7 @@ from ..history.packing import (EncodedHistory, encode_history, pack_batch,
 from ..ops.dense_scan import dense_plans_grouped, make_dense_batch_checker
 from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
                                make_batch_checker)
+from ..ops.segment_scan import LONG_HISTORY_MIN_EVENTS, check_segmented_batch
 from .base import Checker, INVALID, UNKNOWN, VALID
 from .dfs_cpu import SearchBudgetExceeded, check_encoded_dfs
 from .wgl_cpu import FrontierOverflow, check_encoded_cpu
@@ -132,8 +133,45 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
         if e.n_events == 0:
             results[i] = {"valid?": VALID, "algorithm": "trivial",
                           "op-count": 0}
+    # Resolved before any routing: the group loop below rebinds `kernel`
+    # to the compiled callable, and the segment router must also honor
+    # an explicit pallas request (an ablation asking for pallas must not
+    # silently measure the segmented XLA kernel).
+    want_pallas = (kernel == "pallas" or
+                   os.environ.get("JGRAFT_KERNEL") == "pallas")
+    if fits and n_configs is None and n_slots is None and not want_pallas:
+        # Long histories first: the segmented scan (ops/segment_scan.py)
+        # cuts a 100k+-event stream at quiescent boundaries and runs the
+        # segments concurrently — the blockwise treatment of SURVEY §5.7.
+        # Exact (differentially pinned vs the monolithic kernels);
+        # ineligible histories (short, cut-free, non-dense) fall through.
+        #
+        # Routed only where measured to win (TPU v5e, 2026-07-30): a
+        # FEW very long histories — depth is the wall-clock driver and
+        # segmentation trades it for basis-redundant width the VPU
+        # absorbs (config #5: 4.0s vs 4.4s monolithic). With many long
+        # histories the monolithic vmap already fills the chip and the
+        # basis redundancy only hurts (16×10k: 12.5 vs 2.0 hist/s);
+        # on CPU the redundant width swamps the host outright (>10×
+        # slower). JGRAFT_SEGMENT=1/0 forces the choice (tests, ablation).
+        long_idx = [i for i in fits
+                    if encs[i].n_events >= LONG_HISTORY_MIN_EVENTS]
+        if long_idx and not _segment_routing_on(len(long_idx)):
+            long_idx = []
+        if long_idx:
+            t0 = time.perf_counter()
+            seg = check_segmented_batch([encs[i] for i in long_idx], model)
+            dt = time.perf_counter() - t0
+            n_done = sum(1 for r in seg if r is not None)
+            for j, i in enumerate(long_idx):
+                if seg[j] is not None:
+                    r = _jx(VALID if seg[j]["valid"] else INVALID, encs[i],
+                            dt / max(n_done, 1), kernel="dense-seg")
+                    r["segments"] = seg[j]["segments"]
+                    results[i] = r
+            fits = [i for i in fits if results[i] is None]
     if fits:
-        # Dense-bitset kernel first: exact (no overflow, no escalation)
+        # Dense-bitset kernel next: exact (no overflow, no escalation)
         # and ~10× the sort kernel when the model's state domain is
         # enumerable and the window is small — the shapes the reference's
         # own workloads produce. Pinned n_configs/n_slots are sort-kernel
@@ -143,12 +181,6 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                                              [encs[i] for i in fits])
                          if n_configs is None and n_slots is None
                          else ([], list(range(len(fits)))))
-        # Resolved once, BEFORE the loop: the loop body rebinds `kernel`
-        # to the compiled callable, so reading the parameter inside the
-        # second iteration would silently route every later window group
-        # to the XLA dense kernel while labeling it pallas.
-        want_pallas = (kernel == "pallas" or
-                       os.environ.get("JGRAFT_KERNEL") == "pallas")
         if grouped:
             for idxs, plan in grouped:
                 sub = [fits[j] for j in idxs]
@@ -222,6 +254,15 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             if not remaining:
                 break
     return results
+
+
+def _segment_routing_on(n_long: int) -> bool:
+    forced = os.environ.get("JGRAFT_SEGMENT")
+    if forced is not None:
+        return forced == "1"
+    import jax
+
+    return jax.default_backend() == "tpu" and n_long <= 2
 
 
 #: DFS step budget in race mode: enough for any history the harness

@@ -156,6 +156,13 @@ def cmd_test(args) -> int:
             "store_root": args.store,
             "algorithm": args.algorithm,
         }
+        if args.workload == "election":
+            # Opt-in majority model: wired whenever the deployment can
+            # snapshot every node's view (local + ssh clusters can).
+            probe = getattr(db, "cluster", None)
+            probe = getattr(probe, "views_probe", None)
+            if probe is not None:
+                opts["views_probe"] = probe
         test = compose_test(opts, db=db, net=net)
         try:
             test = run_test(test)

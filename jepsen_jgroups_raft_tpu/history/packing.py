@@ -70,12 +70,16 @@ class EncodedHistory:
 def encode_history(
     history: Union[History, Sequence[Op]],
     model,
+    prune: bool = True,
 ) -> EncodedHistory:
     """Compile a history into the event-stream representation.
 
     The model provides per-pair encoding (opcode, args, forced?) via
     ``model.encode_pair``; this function owns slot assignment and event
     ordering. Real-time order is the order of ops in the history.
+    `prune` enables the dead-crashed-op pre-pass (verdict-preserving;
+    see `_prune_dead_crashed` — differential tests pin pruned vs
+    unpruned encodings against the CPU oracle).
     """
 
     ops = list(history)
@@ -93,6 +97,8 @@ def encode_history(
         opens[ip] = (pair, enc)
         if enc.forced:
             forces[pos[id(pair.completion)]] = ip
+    if prune:
+        _prune_dead_crashed(model, opens, forces)
 
     rows: List[tuple] = []
     op_idx: List[int] = []
@@ -123,6 +129,59 @@ def encode_history(
         n_slots=next_slot,
         n_ops=len(opens),
     )
+
+
+def _prune_dead_crashed(model, opens: dict, forces: dict) -> None:
+    """Drop crashed (optional) ops that provably cannot change the
+    verdict, BEFORE slot assignment — each drop frees a never-retiring
+    slot, and kernel cost is exponential in the window (SURVEY §7.4.3;
+    reference doc/intro.md:35-41 names crashed ops as the checker-
+    pressure problem).
+
+    Soundness: let c be an optional op and V = model.enable_values(c)
+    the only state values linearizing c can newly expose. If no op that
+    could linearize after c (= any op not FORCEd before c's invocation)
+    observes any v ∈ V, then (⇐) a witness without c is a witness for
+    both op sets, and (⇒) removing c from a witness keeps it legal: the
+    op right after c cannot be one whose legality needs c's value (none
+    observes it), so it is unconditionally legal (e.g. a register
+    write) and the state trajectory re-converges — verdicts are equal.
+    Iterated to fixpoint: each step preserves the verdict of the
+    surviving set, so the composition does too. Models opt in via the
+    enable/observe hooks; any None disables the pass (conservative)."""
+    force_pos = {ip: cp for cp, ip in forces.items()}
+    observers = []  # (invoke pos, force pos or None, frozenset(values))
+    for ip, (pair, enc) in opens.items():
+        ov = model.observe_values(enc)
+        if ov is None:
+            return
+        observers.append((ip, force_pos.get(ip), frozenset(ov)))
+    changed = True
+    while changed:
+        changed = False
+        for ip, (pair, enc) in list(opens.items()):
+            if enc.forced:
+                continue
+            ev = model.enable_values(enc)
+            if ev is None or not set(ev):
+                # No enable set known → keep; empty enable set → the op
+                # exposes nothing, but optional no-ops cannot constrain
+                # anything either, so drop it outright.
+                if ev is not None:
+                    del opens[ip]
+                    observers = [o for o in observers if o[0] != ip]
+                    changed = True
+                continue
+            observed = set()
+            for oip, fpos, vals in observers:
+                if oip == ip:
+                    continue
+                if fpos is None or fpos > ip:
+                    observed |= vals
+            if not (set(ev) & observed):
+                del opens[ip]
+                observers = [o for o in observers if o[0] != ip]
+                changed = True
 
 
 def pad_batch_bucketed(events: np.ndarray, tables=(), floor_b: int = 8,

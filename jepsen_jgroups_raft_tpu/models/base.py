@@ -110,6 +110,24 @@ class Model:
         `mask_determined` is True."""
         raise NotImplementedError
 
+    # -- crashed-op pruning hooks (SURVEY §7.4.3: crashed ops never
+    # retire and double the search frontier; these let the encoder prove
+    # some of them irrelevant and drop them before slot assignment) ----
+
+    def enable_values(self, enc: EncodedOp):
+        """State values that linearizing this op can newly expose to
+        later ops (e.g. a register write's value), or None when the
+        model cannot answer — None disables pruning for this op."""
+        return None
+
+    def observe_values(self, enc: EncodedOp):
+        """State values this op's legality depends on observing (e.g. a
+        register read's expected value, a CAS's from-value), or None
+        when the model cannot answer — None disables pruning for the
+        whole history (every op's observations must be known for the
+        'nobody observes v downstream' proof to hold)."""
+        return None
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         raise NotImplementedError
 

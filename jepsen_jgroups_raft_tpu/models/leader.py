@@ -16,7 +16,7 @@ from typing import Iterable, Optional, Tuple
 
 import numpy as np
 
-from ..history.ops import OK, History, Op
+from ..history.ops import INVOKE, OK, History, Op
 
 
 class LeaderModel:
@@ -53,6 +53,86 @@ class LeaderModel:
                 f"two leaders observed for term {bad_term}: {leaders}"
             )
             result["term"] = int(bad_term)
+        return result
+
+
+class MajorityLeaderModel(LeaderModel):
+    """Opt-in strengthening past the reference's parity point.
+
+    The reference deliberately does NOT check cross-node agreement
+    (leader.clj:58-62: a partitioned node can legitimately still think X
+    is leader — stale views are not errors). But this build's DB probes
+    EVERY node's local view (deploy/local.py primaries), so stronger —
+    still sound — invariants are checkable from `views` observations
+    (ops with f="views", value = [(node, leader, term), ...]):
+
+      1. POOLED election safety: one leader per term across every
+         node's view, not just the connected node's. Two same-term
+         majorities with different leaders must share a node (majorities
+         intersect), and that node's two reports collide here — so a
+         genuine dual-majority view fails while a stale minority view
+         (old leader at an OLD term) passes.
+      2. Per-node term monotonicity: a node's reported term never goes
+         backward. Lagging forever is fine; regressing is not (Raft
+         terms are monotone per server: currentTerm only grows).
+    """
+
+    name = "leader-majority"
+
+    def check(self, history: History) -> dict:
+        result = super().check(history)  # inspect-op safety (parity)
+        pooled = []  # (term, leader_id) across inspect + views
+        # node -> [(invoke_idx, ok_idx, term)] — both endpoints kept
+        # because concurrent views ops have no order: monotonicity may
+        # only be asserted between snapshots where one op COMPLETED
+        # before the other was INVOKED (a later-invoked op overlapping
+        # an earlier one can legitimately land first in the history).
+        by_node: dict = {}
+        pending: dict = {}  # process -> invoke idx of its open views op
+        interned = dict(self._leaders)
+        for idx, op in enumerate(history):
+            if op.f == "views" and op.type == INVOKE:
+                pending[op.process] = idx
+            if op.type != OK:
+                continue
+            if op.f == "inspect":
+                leader, term = op.value
+                if leader is not None:
+                    lid = interned.setdefault(leader, len(interned))
+                    pooled.append((int(term), lid))
+            elif op.f == "views":
+                inv = pending.pop(op.process, idx)
+                for node, leader, term in op.value or ():
+                    if leader is None:
+                        continue
+                    lid = interned.setdefault(leader, len(interned))
+                    pooled.append((int(term), lid))
+                    by_node.setdefault(node, []).append(
+                        (inv, idx, int(term)))
+        obs = np.asarray(pooled, dtype=np.int32).reshape(-1, 2)
+        ok, bad_term = check_election_safety_np(obs)
+        if not ok:
+            by_id = {v: k for k, v in interned.items()}
+            leaders = sorted({by_id[int(l)] for t, l in obs
+                              if int(t) == bad_term})
+            result["valid?"] = False
+            result["error"] = ("cross-node election safety: two leaders "
+                              f"for term {bad_term}: {leaders}")
+            result["term"] = int(bad_term)
+        for node, snaps in sorted(by_node.items()):
+            # Sweep in completion order; compare each snapshot only
+            # against the max term of snapshots that happened-before it
+            # (completed before its invocation).
+            done = sorted(snaps, key=lambda s: s[1])
+            for inv_j, _, term_j in sorted(snaps):
+                prior = [t for _, okp, t in done if okp < inv_j]
+                if prior and term_j < max(prior):
+                    result["valid?"] = False
+                    result["error"] = (
+                        f"node {node} term went backward: {max(prior)} "
+                        f"-> {term_j} across non-overlapping snapshots")
+                    return result
+        result["view-count"] = int(sum(len(t) for t in by_node.values()))
         return result
 
 

@@ -75,6 +75,25 @@ class CasRegister(Model):
         vals.update(int(v) for v in opens[opens[:, 2] == CAS][:, 4])
         return [int(self.initial)] + sorted(vals - {int(self.initial)})
 
+    def enable_values(self, enc: EncodedOp):
+        """Linearizing a write exposes state a; a cas exposes its
+        to-value b; a read exposes nothing."""
+        if enc.f == WRITE:
+            return (enc.a,)
+        if enc.f == CAS:
+            return (enc.b,)
+        return ()
+
+    def observe_values(self, enc: EncodedOp):
+        """A read is legal iff the state equals its returned value; a
+        cas iff the state equals its from-value; a write observes
+        nothing (unconditionally legal)."""
+        if enc.f == READ:
+            return (enc.a,)
+        if enc.f == CAS:
+            return (enc.a,)
+        return ()
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         f = pair.f
         forced = pair.ctype == OK

@@ -14,25 +14,37 @@ from ..checker.timeline import TimelineChecker
 from ..client.base import Client
 from ..generator.base import Limit, Mix
 from ..history.ops import History, OK, Op
-from ..models.leader import LeaderModel
+from ..models.leader import LeaderModel, MajorityLeaderModel
 
 
 def inspect(test, ctx):
     return {"f": "inspect", "value": None}
 
 
+def views(test, ctx):
+    return {"f": "views", "value": None}
+
+
 class LeaderInspectionClient(Client):
-    def __init__(self, conn_factory, timeout: float = 10.0):
+    def __init__(self, conn_factory, timeout: float = 10.0,
+                 views_probe=None):
         self.conn_factory = conn_factory
         self.timeout = timeout
+        self.views_probe = views_probe
         self.conn = None
 
     def open(self, test, node):
-        c = LeaderInspectionClient(self.conn_factory, self.timeout)
+        c = LeaderInspectionClient(self.conn_factory, self.timeout,
+                                   self.views_probe)
         c.conn = self.conn_factory(node, "election", self.timeout)
         return c
 
     def invoke(self, test, op: Op) -> Op:
+        if op.f == "views":
+            # Every node's local (leader, term) — the primaries-probe
+            # data the majority checker consumes. Unreachable nodes are
+            # simply absent (their staleness is the tolerated case).
+            return op.replace(type=OK, value=self.views_probe())
         if op.f != "inspect":
             raise ValueError(f"election: unknown op {op.f!r}")
         leader, term = self.conn.inspect()
@@ -44,26 +56,36 @@ class LeaderInspectionClient(Client):
 
 
 class ElectionSafetyChecker(Checker):
+    def __init__(self, majority: bool = False):
+        self.majority = majority
+
     def check(self, test, history, opts=None) -> dict:
         if not isinstance(history, History):
             history = History(history)
-        return LeaderModel().check(history.client_ops())
+        model = MajorityLeaderModel() if self.majority else LeaderModel()
+        return model.check(history.client_ops())
 
 
 def leader_workload(opts: dict) -> dict:
     total_ops = opts.get("total_ops")
-    gen = Mix([inspect])
+    views_probe = opts.get("views_probe")
+    # Opt-in strengthening (VERDICT r2 #7): with a views probe wired,
+    # every 4th op snapshots all nodes' views and the checker runs the
+    # cross-node majority model on top of the parity check.
+    gen = Mix([inspect, inspect, inspect, views] if views_probe
+              else [inspect])
     if total_ops:
         gen = Limit(total_ops, gen)
     return {
         "client": LeaderInspectionClient(
-            opts["conn_factory"], opts.get("operation_timeout", 10.0)),
+            opts["conn_factory"], opts.get("operation_timeout", 10.0),
+            views_probe=views_probe),
         "checker": compose({
             "timeline": TimelineChecker(),
             "stats": StatsChecker(),
-            "linear": ElectionSafetyChecker(),
+            "linear": ElectionSafetyChecker(majority=bool(views_probe)),
         }),
         "generator": gen,
-        "idempotent": {"inspect"},  # leader.clj:39
+        "idempotent": {"inspect", "views"},  # leader.clj:39
         "model": LeaderModel,
     }

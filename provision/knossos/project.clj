@@ -1,0 +1,10 @@
+(defproject knossos-bench "0.1.0"
+  :description "Times knossos.competition/analysis on exported histories
+                (the reference's checker engine, raft_test.clj:26) for
+                the BASELINE.md JVM comparison row."
+  :dependencies [[org.clojure/clojure "1.11.1"]
+                 [knossos "0.3.9"]
+                 [org.clojure/data.json "2.4.0"]]
+  ;; Same checker heap the reference grants (reference project.clj:7).
+  :jvm-opts ["-Xmx26g" "-server"]
+  :main knossos-bench.core)

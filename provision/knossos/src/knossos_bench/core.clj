@@ -1,0 +1,39 @@
+(ns knossos-bench.core
+  "Times knossos.competition/analysis per exported history — the exact
+  engine+model combination the reference's tests use
+  (/root/reference/test/jepsen/jgroups/raft_test.clj:26,41,64 with
+  knossos.model/cas-register at workload/register.clj:110-111)."
+  (:require [clojure.edn :as edn]
+            [clojure.java.io :as io]
+            [clojure.data.json :as json]
+            [knossos.competition :as competition]
+            [knossos.model :as model])
+  (:gen-class))
+
+(defn history-files [dir]
+  (->> (file-seq (io/file dir))
+       (filter #(.isFile ^java.io.File %))
+       (filter #(.endsWith (.getName ^java.io.File %) ".edn"))
+       (sort-by #(.getName ^java.io.File %))))
+
+(defn -main [& args]
+  (let [dir (or (first args) "/histories")
+        files (history-files dir)
+        t-total (System/nanoTime)]
+    (when (empty? files)
+      (binding [*out* *err*]
+        (println "no .edn histories under" dir))
+      (System/exit 1))
+    (doseq [[i f] (map-indexed vector files)]
+      (let [history (edn/read-string (slurp f))
+            t0 (System/nanoTime)
+            result (competition/analysis (model/cas-register) history)
+            ms (/ (- (System/nanoTime) t0) 1e6)]
+        (println (json/write-str {:i i
+                                  :file (.getName ^java.io.File f)
+                                  :valid (:valid? result)
+                                  :ms ms}))))
+    (let [secs (/ (- (System/nanoTime) t-total) 1e9)]
+      (println (json/write-str {:histories (count files)
+                                :seconds secs
+                                :histories_per_sec (/ (count files) secs)})))))

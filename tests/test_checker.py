@@ -299,10 +299,17 @@ def test_wide_window_with_info_ops_auto_stays_on_device():
     from jepsen_jgroups_raft_tpu.history.ops import Op
 
     rows = [Op(500, INVOKE, "write", 0), Op(500, OK, "write", 0)]
+    # 50 chained crashed cas ops with the read observing the chain TIP:
+    # every link's to-value is observed (by the next link's from, and
+    # the last by the read), so the dead-crashed-op prune cannot retire
+    # any of them and the full >31 window reaches the kernel — while
+    # the frontier stays linear (prefix chains), not exponential. (The
+    # read used to observe mid-chain value 7, whose unobserved tail the
+    # prune now provably drops, shrinking the window to ~8.)
     for i in range(50):
         rows.append(Op(i, INVOKE, "cas", (i, i + 1)))  # never completes
     rows.append(Op(600, INVOKE, "read", None))
-    rows.append(Op(600, OK, "read", 7))  # chain linearized up to 7
+    rows.append(Op(600, OK, "read", 50))  # chain fully linearized
     for i in range(50):
         rows.append(Op(i, INFO, "cas", (i, i + 1)))
     results = check_histories([rows], CasRegister(), algorithm="auto",
@@ -310,6 +317,25 @@ def test_wide_window_with_info_ops_auto_stays_on_device():
     assert results[0]["valid?"] is True
     assert results[0]["algorithm"] == "jax"
     assert results[0]["concurrency-window"] > 31
+
+
+def test_prune_decides_chained_crashed_cas_cheaply():
+    """The previous wide-window fixture, kept as a prune showcase: 50
+    chained crashed cas ops whose tail nobody observes collapse to the
+    handful that can still explain the read — window ~8, not 51."""
+    from jepsen_jgroups_raft_tpu.history.ops import Op
+
+    rows = [Op(500, INVOKE, "write", 0), Op(500, OK, "write", 0)]
+    for i in range(50):
+        rows.append(Op(i, INVOKE, "cas", (i, i + 1)))  # never completes
+    rows.append(Op(600, INVOKE, "read", None))
+    rows.append(Op(600, OK, "read", 7))  # chain linearized up to 7
+    for i in range(50):
+        rows.append(Op(i, INFO, "cas", (i, i + 1)))
+    results = check_histories([rows], CasRegister(), algorithm="auto")
+    assert results[0]["valid?"] is True
+    assert results[0]["algorithm"] == "jax"
+    assert results[0]["concurrency-window"] <= 10
 
 
 def test_uncorrupted_random_histories_always_valid():

@@ -1,0 +1,127 @@
+"""Opt-in majority election checks (VERDICT r2 #7).
+
+Parity note: the reference deliberately does not check cross-node
+agreement (reference workload/leader.clj:58-62) — `LeaderModel` keeps
+that stance. `MajorityLeaderModel` uses the every-node views snapshots
+this build's DB can take: a partitioned minority's STALE view must be
+tolerated; a genuine dual-majority (same term, two leaders) and a
+node's term running backward must fail.
+"""
+
+from jepsen_jgroups_raft_tpu.history.ops import OK, History, Op
+from jepsen_jgroups_raft_tpu.models.leader import (LeaderModel,
+                                                   MajorityLeaderModel)
+
+
+def _h(rows):
+    h = History()
+    for r in rows:
+        h.append(Op(*r))
+    return h
+
+
+def test_stale_minority_view_is_tolerated():
+    # n4, n5 are partitioned away and still believe the term-3 leader A;
+    # the majority moved on to term 5 under B. Legal — staleness is not
+    # a safety violation (the reference's own reasoning, leader.clj:58-62).
+    h = _h([
+        (0, OK, "views", [("n1", "B", 5), ("n2", "B", 5), ("n3", "B", 5),
+                          ("n4", "A", 3), ("n5", "A", 3)]),
+        (1, OK, "inspect", ("B", 5)),
+        (0, OK, "views", [("n1", "B", 5), ("n2", "B", 5), ("n3", "B", 5),
+                          ("n4", "A", 3), ("n5", "A", 3)]),
+    ])
+    r = MajorityLeaderModel().check(h)
+    assert r["valid?"] is True
+    assert r["view-count"] == 10
+
+
+def test_dual_majority_same_term_fails():
+    # Two "majorities" claim different leaders for the SAME term. Any
+    # two majorities intersect, so some node (here n3) reported both —
+    # the pooled cross-node safety check must catch it. The parity
+    # model (inspect-only) cannot see it: no inspect op conflicts.
+    h = _h([
+        (0, OK, "views", [("n1", "A", 7), ("n2", "A", 7), ("n3", "A", 7)]),
+        (0, OK, "views", [("n3", "B", 7), ("n4", "B", 7), ("n5", "B", 7)]),
+    ])
+    r = MajorityLeaderModel().check(h)
+    assert r["valid?"] is False
+    assert "term 7" in r["error"]
+    # Parity model ignores views ops entirely — stays valid (the gap the
+    # opt-in closes).
+    assert LeaderModel().check(h)["valid?"] is True
+
+
+def test_concurrent_overlapping_views_tolerate_reordered_terms():
+    """Two OVERLAPPING views ops (both invoked before either completes)
+    may land in either order — a late-probing op completing first must
+    not read as a term regression. Only non-overlapping (completed
+    before the other's invocation) snapshots are ordered."""
+    from jepsen_jgroups_raft_tpu.history.ops import INVOKE
+
+    h = _h([
+        (0, INVOKE, "views", None),
+        (1, INVOKE, "views", None),          # overlaps with process 0's
+        (0, OK, "views", [("n1", "A", 6)]),  # probed late, landed first
+        (1, OK, "views", [("n1", "A", 5)]),  # probed early, landed last
+    ])
+    assert MajorityLeaderModel().check(h)["valid?"] is True
+
+
+def test_node_term_regression_fails():
+    # Raft currentTerm is persisted and monotone per server; a node
+    # reporting term 9 then term 4 is a real violation even though no
+    # term ever has two leaders.
+    h = _h([
+        (0, OK, "views", [("n1", "A", 9)]),
+        (0, OK, "views", [("n1", "A", 4)]),
+    ])
+    r = MajorityLeaderModel().check(h)
+    assert r["valid?"] is False
+    assert "backward" in r["error"]
+
+
+def test_inspect_safety_still_applies():
+    # The parity invariant (two leaders, one term, via inspect ops)
+    # must still fail under the majority model.
+    h = _h([
+        (0, OK, "inspect", ("A", 2)),
+        (1, OK, "inspect", ("B", 2)),
+    ])
+    assert MajorityLeaderModel().check(h)["valid?"] is False
+    assert LeaderModel().check(h)["valid?"] is False
+
+
+def test_e2e_election_with_views_on_real_cluster(tmp_path):
+    """Full stack: local 3-node raft cluster, election workload with the
+    views probe mixed in, a kill mid-run to force re-election — the
+    majority checker must see the views ops and pass."""
+    from jepsen_jgroups_raft_tpu.core.compose import compose_test
+    from jepsen_jgroups_raft_tpu.core.runner import run_test
+    from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
+                                                      LocalRaftDB)
+
+    nodes = ["n1", "n2", "n3"]
+    cluster = LocalCluster(nodes, sm="election",
+                           workdir=str(tmp_path / "sut"),
+                           election_ms=150, heartbeat_ms=50)
+    opts = {
+        "name": "election-majority", "nodes": nodes,
+        "workload": "election", "nemesis": "kill",
+        "conn_factory": cluster.conn_factory(),
+        "views_probe": cluster.views_probe,
+        "rate": 30.0, "interval": 2.0, "time_limit": 6.0,
+        "quiesce": 1.0, "operation_timeout": 2.0, "concurrency": 3,
+        "store_root": str(tmp_path / "store"),
+    }
+    test = compose_test(opts, db=LocalRaftDB(cluster, seed=5),
+                        net=BlockNet(cluster), seed=5)
+    try:
+        test = run_test(test)
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    assert res["valid?"] is True, res
+    linear = res["workload"]["linear"]
+    assert linear["view-count"] > 0, linear  # views ops really flowed

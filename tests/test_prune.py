@@ -1,0 +1,117 @@
+"""Dead-crashed-op pruning: verdict preservation.
+
+The pruning pass (history/packing.py `_prune_dead_crashed`) runs inside
+`encode_history`, which EVERY engine shares — so a pruning bug would be
+invisible to the usual engine-vs-engine differentials. These tests pin
+pruned against UNPRUNED encodings through the CPU oracle instead.
+"""
+
+import random
+
+from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
+from jepsen_jgroups_raft_tpu.history.ops import INFO, INVOKE, OK, History, Op
+from jepsen_jgroups_raft_tpu.history.packing import encode_history
+from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+from jepsen_jgroups_raft_tpu.models.register import CasRegister
+
+
+def _h(rows):
+    h = History()
+    for r in rows:
+        h.append(Op(*r))
+    return h
+
+
+def test_prunes_unobserved_crashed_write():
+    m = CasRegister()
+    # The crashed write of 9 is never read back and nothing cas-es from
+    # 9 — it can never matter, and its never-retiring slot goes away.
+    h = _h([(0, INVOKE, "write", 9), (0, INFO, "write", 9),
+            (1, INVOKE, "write", 1), (1, OK, "write", 1),
+            (2, INVOKE, "read", None), (2, OK, "read", 1)])
+    assert encode_history(h, m, prune=False).n_slots == 2
+    enc = encode_history(h, m)
+    assert enc.n_slots == 1
+    assert enc.n_ops == 2
+    assert check_encoded_cpu(enc, m).valid
+
+
+def test_keeps_observed_crashed_write():
+    m = CasRegister()
+    # Here the read NEEDS the crashed write — pruning it would flip the
+    # verdict to invalid. It must survive.
+    h = _h([(0, INVOKE, "write", 9), (0, INFO, "write", 9),
+            (1, INVOKE, "read", None), (1, OK, "read", 9)])
+    enc = encode_history(h, m)
+    assert enc.n_ops == 2
+    assert check_encoded_cpu(enc, m).valid
+
+
+def test_keeps_crashed_write_observed_by_concurrent_earlier_read():
+    """An op invoked BEFORE the crashed write but still open can
+    linearize after it — its observation must keep the write alive."""
+    m = CasRegister()
+    h = _h([(1, INVOKE, "read", None),      # invoked first...
+            (0, INVOKE, "write", 9), (0, INFO, "write", 9),
+            (1, OK, "read", 9)])            # ...but completes after
+    enc = encode_history(h, m)
+    assert enc.n_ops == 2
+    assert check_encoded_cpu(enc, m).valid
+
+
+def test_keeps_crashed_write_observed_by_crashed_cas():
+    """A crashed cas-from-9 can linearize at any time; it observes 9,
+    so a crashed write of 9 must not be pruned (their interaction can
+    matter through the cas's OWN enable value)."""
+    m = CasRegister()
+    h = _h([(0, INVOKE, "write", 9), (0, INFO, "write", 9),
+            (1, INVOKE, "cas", (9, 5)), (1, INFO, "cas", (9, 5)),
+            (2, INVOKE, "read", None), (2, OK, "read", 5)])
+    enc = encode_history(h, m)
+    # Valid: write 9 → cas 9→5 → read 5. Both crashed ops must survive
+    # pruning for the witness to exist.
+    assert check_encoded_cpu(enc, m).valid
+
+
+def test_fixpoint_chain_prunes_transitively():
+    """cas(9→7) is kept only because of the read of 7; once nothing
+    observes 7, both the cas AND the write 9 become prunable — the
+    fixpoint iteration must cascade."""
+    m = CasRegister()
+    rows = [(0, INVOKE, "write", 9), (0, INFO, "write", 9),
+            (1, INVOKE, "cas", (9, 7)), (1, INFO, "cas", (9, 7)),
+            (2, INVOKE, "write", 1), (2, OK, "write", 1),
+            (3, INVOKE, "read", None), (3, OK, "read", 1)]
+    enc = encode_history(_h(rows), m)
+    assert enc.n_ops == 2      # only the forced write+read remain
+    assert enc.n_slots == 1
+    assert check_encoded_cpu(enc, m).valid
+
+
+def test_differential_pruned_vs_unpruned_random():
+    m = CasRegister()
+    rng = random.Random(77)
+    checked = pruned_something = 0
+    for i in range(120):
+        h = random_valid_history(rng, "register", n_ops=30, n_procs=4,
+                                 value_range=6, crash_p=0.25,
+                                 max_crashes=4)
+        if i % 2:
+            ops = list(h)
+            oks = [j for j, op in enumerate(ops)
+                   if op.type == OK and op.f == "read"
+                   and op.value is not None]
+            if oks:
+                j = rng.choice(oks)
+                ops[j] = ops[j].replace(value=(ops[j].value or 0)
+                                        + rng.choice([1, 2, 9]))
+                h = ops
+        enc_p = encode_history(h, m)
+        enc_u = encode_history(h, m, prune=False)
+        if enc_p.n_ops < enc_u.n_ops:
+            pruned_something += 1
+        assert check_encoded_cpu(enc_p, m).valid is \
+            check_encoded_cpu(enc_u, m).valid, i
+        checked += 1
+    assert checked == 120
+    assert pruned_something > 5  # the pass actually fires on this corpus

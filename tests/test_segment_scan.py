@@ -1,0 +1,195 @@
+"""Segmented long-history scan: exactness and routing.
+
+The segmented scan (ops/segment_scan.py) must return the monolithic
+kernels' exact verdict — its soundness argument (quiescent cuts bound
+the reachable configuration space to subsets of the crashed-open slots;
+segments are join-morphisms, so seed→frontier tables compose) is pinned
+here differentially against the unbounded CPU frontier on valid AND
+corrupted histories, plus structural cases: cut-free streams fall back,
+crashed ops spanning segment boundaries keep their ambiguity.
+"""
+
+import random
+
+import numpy as np
+
+from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
+from jepsen_jgroups_raft_tpu.history.ops import INFO, INVOKE, OK, History, Op
+from jepsen_jgroups_raft_tpu.history.packing import encode_history
+from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+from jepsen_jgroups_raft_tpu.models.register import CasRegister
+from jepsen_jgroups_raft_tpu.ops.segment_scan import (check_segmented_batch,
+                                                      find_cuts,
+                                                      plan_segments)
+
+
+def _h(rows):
+    h = History()
+    for r in rows:
+        h.append(Op(*r))
+    return h
+
+
+def _corrupt_read(rng, h, delta=1):
+    """delta=1 may or may not break linearizability (a concurrent write
+    can legitimize it — the CPU oracle decides); delta=10 lands outside
+    the synthesizer's value range, guaranteeing INVALID."""
+    ops = list(h)
+    reads = [j for j, op in enumerate(ops)
+             if op.type == OK and op.f == "read" and op.value is not None]
+    if not reads:
+        return h
+    j = rng.choice(reads)
+    ops[j] = ops[j].replace(value=ops[j].value + delta)
+    return ops
+
+
+def test_differential_vs_cpu_valid_and_corrupted():
+    m = CasRegister()
+    rng = random.Random(42)
+    encs = []
+    for i in range(20):
+        h = random_valid_history(rng, "register", n_ops=300, n_procs=4,
+                                 crash_p=0.03, max_crashes=3)
+        if i % 2:
+            h = _corrupt_read(rng, h)
+        encs.append(encode_history(h, m))
+    rs = check_segmented_batch(encs, m, block_events=40, min_events=0)
+    for enc, r in zip(encs, rs):
+        assert r is not None
+        assert r["valid"] is check_encoded_cpu(enc, m).valid
+        assert r["segments"] > 1
+
+
+def test_crash_ambiguity_spans_segments():
+    """A crashed write whose value is read far downstream: the crashed
+    slot's 'maybe linearized later' bit must survive segment composition
+    (C_k sets are nested; the bit travels through every basis)."""
+    m = CasRegister()
+    rows = [(0, INVOKE, "write", 7), (0, INFO, "write", 7)]
+    # Many quiescent single-op rounds — forces segmentation points.
+    for i in range(100):
+        rows += [(1, INVOKE, "write", 1), (1, OK, "write", 1)]
+    # The crashed write takes effect only now.
+    rows += [(2, INVOKE, "read", None), (2, OK, "read", 7)]
+    enc = encode_history(_h(rows), m)
+    [r] = check_segmented_batch([enc], m, block_events=20, min_events=0)
+    assert r is not None and r["segments"] > 2
+    assert r["valid"] is True
+
+    # Same shape, but the read observes a value nobody could have
+    # written — must stay INVALID through the same segmentation.
+    rows[-1] = (2, OK, "read", 9)
+    rows[-2] = (2, INVOKE, "read", None)
+    enc = encode_history(_h(rows), m)
+    [r] = check_segmented_batch([enc], m, block_events=20, min_events=0)
+    assert r is not None and r["valid"] is False
+
+
+def test_cut_free_stream_falls_back():
+    """Two processes whose ops always overlap (each invoke lands before
+    the other's completion): no quiescent boundary ever, plan is None."""
+    m = CasRegister()
+    # Alternate invoke/complete so at least one op is always open.
+    rows = [(0, INVOKE, "write", 1)]
+    open_val = {0: 1}
+    for i in range(50):
+        p = i % 2
+        q = 1 - p
+        v = (i + 1) % 3
+        rows.append((q, INVOKE, "write", v))
+        rows.append((p, OK, "write", open_val[p]))
+        open_val[q] = v
+    enc = encode_history(_h(rows), m)
+    positions, _, _ = find_cuts(enc.events)
+    # Only the trivial boundaries survive: start and stream end.
+    assert all(p in (0, enc.n_events) for p in positions)
+    assert plan_segments(m, enc, block_events=10, min_events=0) is None
+
+
+def test_checker_routes_long_histories_to_segment_scan(monkeypatch):
+    # Routing is measured-TPU-only by default; force it on for the CPU
+    # test env (JGRAFT_SEGMENT is the documented override).
+    monkeypatch.setenv("JGRAFT_SEGMENT", "1")
+    m = CasRegister()
+    rng = random.Random(9)
+    h = random_valid_history(rng, "register", n_ops=6000, n_procs=5,
+                             crash_p=0.01, max_crashes=3)
+    bad = _corrupt_read(rng, h, delta=10)
+    rs = check_histories([h, bad], m, algorithm="jax")
+    assert [r["valid?"] for r in rs] == [True, False]
+    assert all(r["kernel"] == "dense-seg" for r in rs), rs
+    assert all(r["segments"] > 1 for r in rs)
+
+
+def test_explicit_pallas_is_not_hijacked_by_segment_routing(monkeypatch):
+    """algorithm='pallas' is an ablation hook: a long history must run
+    the Pallas kernel (or its interpret twin off-TPU), not silently get
+    re-routed to the segmented XLA kernel."""
+    monkeypatch.setenv("JGRAFT_SEGMENT", "1")
+    m = CasRegister()
+    rng = random.Random(9)
+    h = random_valid_history(rng, "register", n_ops=6000, n_procs=5,
+                             crash_p=0.01, max_crashes=2)
+    [r] = check_histories([h], m, algorithm="pallas")
+    assert r["kernel"] == "pallas", r
+
+
+def test_batch_bucketing_recheck_sheds_blown_bases():
+    """plan_segments gates each history with its OWN domain size; a
+    wide-domain batch partner inflates S and can push another history's
+    basis past MAX_BASIS — such histories must fall back (None), not
+    launch a 16x-wider kernel than the gate allows."""
+    import jepsen_jgroups_raft_tpu.ops.segment_scan as ss
+
+    m = CasRegister()
+    rng = random.Random(38)  # seed chosen so A carries 3 crashed-open
+    # History A: tiny domain, several crashes — passes its own gate
+    # (nb = 2^c · S_A = 32), but at the batch S below it would blow the
+    # CPU step budget the gate protects (8 · 16 · 2^7 · 16 = 262k cells).
+    a = random_valid_history(rng, "register", n_ops=400, n_procs=4,
+                             value_range=3, crash_p=0.25, max_crashes=3)
+    # History B: wide (but dense-eligible) domain inflates the batch S.
+    b = random_valid_history(rng, "register", n_ops=400, n_procs=4,
+                             value_range=14, crash_p=0.0)
+    enc_a = encode_history(a, m)
+    enc_b = encode_history(b, m)
+    rs = check_segmented_batch([enc_a, enc_b], m, block_events=40,
+                               min_events=0)
+    # The recheck loop must shed at least the offender; whatever
+    # survives respects the gates and stays exact.
+    assert any(r is None for r in rs), rs
+    for enc, r in zip([enc_a, enc_b], rs):
+        if r is not None:
+            assert r["basis"] <= ss.MAX_BASIS
+            assert r["valid"] is check_encoded_cpu(enc, m).valid
+
+
+def test_verdicts_match_monolithic_kernel_on_long_history():
+    """The whole point: segmented and monolithic paths agree on the
+    same encoded history (here: forced monolithic via the mesh path)."""
+    from jepsen_jgroups_raft_tpu.history.packing import pack_batch
+    from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plans_grouped
+    from jepsen_jgroups_raft_tpu.parallel.mesh import (check_batch_sharded,
+                                                       make_mesh)
+
+    m = CasRegister()
+    rng = random.Random(10)
+    encs = [encode_history(
+        random_valid_history(rng, "register", n_ops=2000, n_procs=5,
+                             crash_p=0.02, max_crashes=3), m)
+        for _ in range(3)]
+    seg = check_segmented_batch(encs, m, min_events=0)
+    grouped, rest = dense_plans_grouped(m, encs)
+    assert not rest
+    mono = np.zeros(len(encs), dtype=bool)
+    batch = pack_batch(encs)
+    mesh = make_mesh()
+    for idxs, plan in grouped:
+        ok, _, _, _ = check_batch_sharded(m, batch["events"][idxs], mesh,
+                                          dense=plan)
+        mono[idxs] = ok
+    for i, r in enumerate(seg):
+        assert r is not None
+        assert r["valid"] is bool(mono[i])
