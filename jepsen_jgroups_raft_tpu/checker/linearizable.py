@@ -48,7 +48,8 @@ import numpy as np
 from ..history.ops import History
 from ..history.packing import (EncodedHistory, encode_history, pack_batch,
                                pad_batch_bucketed)
-from ..ops.dense_scan import dense_plans_grouped, make_dense_batch_checker
+from ..ops.dense_scan import (MERGE_MAX_EVENTS, dense_plans_grouped,
+                              make_dense_batch_checker)
 from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
                                make_batch_checker)
 from ..ops.segment_scan import LONG_HISTORY_MIN_EVENTS, check_segmented_batch
@@ -185,8 +186,17 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             for idxs, plan in grouped:
                 sub = [fits[j] for j in idxs]
                 batch = pack_batch([encs[i] for i in sub])
-                ev, (val_of,), B = pad_batch_bucketed(batch["events"],
-                                                      (plan.val_of,))
+                # Bucketing trades padding work for jit-cache stability.
+                # For a FEW LONG histories the trade inverts: padding a
+                # 2-history 16k-event group to 8 rows quadruples its
+                # kernel time, while the compile cache only ever sees a
+                # handful of such launches — use exact shapes there.
+                e_len = batch["events"].shape[1]
+                exact = (e_len > MERGE_MAX_EVENTS and len(sub) <= 16)
+                ev, (val_of,), B = pad_batch_bucketed(
+                    batch["events"], (plan.val_of,),
+                    floor_b=len(sub) if exact else 8,
+                    floor_e=None if exact else 32)
                 tag = plan.kernel_tag
                 if want_pallas and plan.kind == "domain":
                     # Pallas path (ops/pallas_scan.py): same search,

@@ -16,6 +16,20 @@ from typing import List, Optional
 from ..core.db import DB
 
 
+def collect_views(probe, members) -> list:
+    """[(node, leader, term)] for every reachable member — the snapshot
+    the opt-in majority election checker consumes. Shared by both
+    cluster tiers (`views_probe` on LocalCluster / RemoteRaftCluster);
+    unreachable or leaderless nodes are absent, which is the tolerated
+    staleness case."""
+    out = []
+    for n in list(members):
+        v = probe(n)
+        if v is not None and v[0] is not None:
+            out.append((n, v[0], int(v[1])))
+    return out
+
+
 class RaftDB(DB):
     def __init__(self, cluster, seed: Optional[int] = None):
         self.cluster = cluster

@@ -180,16 +180,8 @@ class LocalCluster:
                 conn.close()
 
     def views_probe(self):
-        """[(node, leader, term)] for every reachable member — the
-        cross-node snapshot the opt-in majority election checker
-        consumes (unreachable/stale nodes are simply absent, which is
-        the tolerated case)."""
-        out = []
-        for n in list(self.procs):
-            v = self.probe(n)
-            if v is not None and v[0] is not None:
-                out.append((n, v[0], int(v[1])))
-        return out
+        from .base import collect_views
+        return collect_views(self.probe, self.procs)
 
     def conn_factory(self):
         return make_conn_factory(self.resolve)

@@ -255,16 +255,8 @@ class RemoteRaftCluster:
                 conn.close()
 
     def views_probe(self):
-        """[(node, leader, term)] for every reachable member — feeds the
-        opt-in majority election checker (same contract as
-        deploy/local.py LocalCluster.views_probe; unreachable nodes are
-        absent, which is the tolerated staleness case)."""
-        out = []
-        for n in list(self.nodes):
-            v = self.probe(n)
-            if v is not None and v[0] is not None:
-                out.append((n, v[0], int(v[1])))
-        return out
+        from .base import collect_views
+        return collect_views(self.probe, self.nodes)
 
     def admin(self, name: str, timeout: float = 15.0) -> NativeConn:
         return NativeConn(name, self.client_port, timeout)

@@ -149,6 +149,8 @@ def _prune_dead_crashed(model, opens: dict, forces: dict) -> None:
     Iterated to fixpoint: each step preserves the verdict of the
     surviving set, so the composition does too. Models opt in via the
     enable/observe hooks; any None disables the pass (conservative)."""
+    if all(enc.forced for _, enc in opens.values()):
+        return  # no crashed candidates — skip building the observer list
     force_pos = {ip: cp for cp, ip in forces.items()}
     observers = []  # (invoke pos, force pos or None, frozenset(values))
     for ip, (pair, enc) in opens.items():
@@ -211,8 +213,15 @@ def pad_batch_bucketed(events: np.ndarray, tables=(), floor_b: int = 8,
 
 
 def _bucket_pow2(n: int, floor: int) -> int:
+    """Next bucket ≥ n from the series floor·{1, 1.5, 2, 3, 4, 6, 8…}
+    (powers of two plus their midpoints): padding waste is capped at
+    ~33% instead of pow2's 2×, while the jit-cache shape count only
+    doubles. The 1000-history north-star batch measured 1.34× padded
+    rows under pure pow2 bucketing — real kernel time, not headroom."""
     b = floor
     while b < n:
+        if b + b // 2 >= n:
+            return b + b // 2
         b *= 2
     return b
 

@@ -126,6 +126,13 @@ def dense_plan(model, encs: Sequence[EncodedHistory]) -> Optional[DensePlan]:
 #: amortization beats a snugger W for tiny groups).
 DENSE_MIN_GROUP = 16
 
+#: ...unless the histories are LONG: kernel work is E · 2^W cells per
+#: history, so pushing a 15k-event W=6 history into a W=8 group costs 4×
+#: its whole scan — far more than the launch it saves. Past this event
+#: count every window gets its own snug launch (measured on config #4:
+#: merged-to-W=8 1.9 s vs per-window 1.3 s on v5e).
+MERGE_MAX_EVENTS = 4096
+
 
 def _pad_domains(domains, idxs):
     """[len(idxs), S] id→value table from per-history domains, S bucketed
@@ -169,39 +176,53 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
         else:
             rest.append(i)
     groups: list = []
+
+    def flush(kind, pending, w):
+        """Emit (indices, plan) for one group, or None when the whole
+        group sheds. Domain mode re-checks the cell envelope: eligibility
+        used each history's own W and unpadded |domain|, but the merged
+        group launches at the widest W with S bucketed up to a power of
+        two — which can exceed the cap (e.g. stragglers merged into a
+        2^10 window with S padded 9→16 = 16384 cells, 2× the cap). The
+        widest histories shed to the sort ladder rather than launch an
+        oversized kernel."""
+        if kind == "mask":
+            return (pending, DensePlan(
+                "mask", w, 1, np.zeros((len(pending), 1), dtype=np.int32)))
+        S, val_of = _pad_domains(domains, pending)
+        w_eff = max(max(encs[i].n_slots for i in pending), 1)
+        while (1 << w_eff) * S > DENSE_MAX_CELLS and pending:
+            widest = max(pending, key=lambda i: encs[i].n_slots)
+            pending.remove(widest)
+            rest.append(widest)
+            if pending:
+                S, val_of = _pad_domains(domains, pending)
+                w_eff = max(max(encs[i].n_slots for i in pending), 1)
+        if not pending:
+            return None
+        return (pending, DensePlan("domain", w_eff, S, val_of))
+
     for kind in ("domain", "mask"):
         windows = sorted(w for k, w in buckets if k == kind)
         pending: list = []
         for w in windows:
-            pending += buckets[(kind, w)]
-            if len(pending) >= DENSE_MIN_GROUP or w == windows[-1]:
-                if kind == "domain":
-                    S, val_of = _pad_domains(domains, pending)
-                    # Flush-time envelope re-check: eligibility above used
-                    # each history's own W and unpadded |domain|, but the
-                    # merged group launches at the widest W with S bucketed
-                    # up to a power of two — which can exceed the cell cap
-                    # (e.g. stragglers merged into a 2^10 window with S
-                    # padded 9→16 = 16384 cells, 2× the cap). Shed the
-                    # widest histories to the sort ladder rather than
-                    # launch an oversized kernel.
-                    w_eff = max(max(encs[i].n_slots for i in pending), 1)
-                    while (1 << w_eff) * S > DENSE_MAX_CELLS and pending:
-                        widest = max(pending, key=lambda i: encs[i].n_slots)
-                        pending.remove(widest)
-                        rest.append(widest)
-                        if pending:
-                            S, val_of = _pad_domains(domains, pending)
-                            w_eff = max(max(encs[i].n_slots
-                                            for i in pending), 1)
-                    if not pending:
-                        continue
-                    plan = DensePlan("domain", w_eff, S, val_of)
-                else:
-                    plan = DensePlan(
-                        "mask", w, 1,
-                        np.zeros((len(pending), 1), dtype=np.int32))
-                groups.append((pending, plan))
+            bucket = buckets[(kind, w)]
+            long_bucket = any(encs[i].n_events > MERGE_MAX_EVENTS
+                              for i in bucket)
+            if long_bucket and pending:
+                # Flush accumulated short stragglers FIRST: merging them
+                # into the long launch would pad their event streams to
+                # the long history's length (E dominates kernel work).
+                g = flush(kind, pending, w)
+                if g is not None:
+                    groups.append(g)
+                pending = []
+            pending += bucket
+            min_group = 1 if long_bucket else DENSE_MIN_GROUP
+            if len(pending) >= min_group or w == windows[-1]:
+                g = flush(kind, pending, w)
+                if g is not None:
+                    groups.append(g)
                 pending = []
     return groups, rest
 

@@ -87,32 +87,28 @@ def north_star_histories():
 
 
 def store_histories(run_dir: str):
-    """Load history.jsonl; split independent-tuple values per key
-    (value = [k, v] rows — the multi-register workload shape)."""
-    ops = []
-    with open(os.path.join(run_dir, "history.jsonl")) as f:
-        for line in f:
-            if line.strip():
-                ops.append(json.loads(line))
-    tupled = any(isinstance(o.get("value"), list) and len(o["value"]) == 2
-                 for o in ops if o["type"] == "invoke")
-    if not tupled:
-        return [ops]
-    per_key: dict = {}
-    open_key: dict = {}  # process -> key of its open invocation
-    for o in ops:
-        if o["type"] == "invoke":
-            k, v = o["value"]
-            open_key[o["process"]] = k
-        else:
-            k = open_key.get(o["process"])
-            if k is None:
-                continue
-            v = o["value"][1] if isinstance(o.get("value"), list) else None
-        o2 = dict(o)
-        o2["value"] = v
-        per_key.setdefault(k, []).append(o2)
-    return [per_key[k] for k in sorted(per_key)]
+    """Load a recorded run and split it per key — through the SAME
+    loader + client-op filter + independent split the product checker
+    uses (core/store.load_history → History.client_ops →
+    checker/independent.split_by_key), so the exported histories are
+    exactly what `check` would verify: nemesis ops filtered, tuple
+    values unwrapped."""
+    from jepsen_jgroups_raft_tpu.checker.independent import split_by_key
+    from jepsen_jgroups_raft_tpu.core.store import load_history
+
+    hist = load_history(run_dir).client_ops()
+    tupled = any(isinstance(o.value, (list, tuple)) and len(o.value) == 2
+                 for o in hist if o.type == "invoke")
+    per_key = split_by_key(hist) if tupled else {None: hist}
+    out = []
+    for k in sorted(per_key, key=str):
+        ops = per_key[k]
+        out.append([{"process": o.process, "type": o.type, "f": o.f,
+                     "value": list(o.value) if isinstance(o.value, tuple)
+                     else o.value,
+                     "index": i, "time": o.time}
+                    for i, o in enumerate(ops)])
+    return out
 
 
 def main(argv=None) -> int:

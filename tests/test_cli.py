@@ -8,6 +8,8 @@ import pytest
 from jepsen_jgroups_raft_tpu.cli import main
 from jepsen_jgroups_raft_tpu.core.serve import _index_html, _run_dirs
 
+pytestmark = pytest.mark.slow
+
 
 def test_cli_test_command_local_native(tmp_path):
     """Full CLI run over the local native deployment: exit 0 and a

@@ -89,12 +89,15 @@ class TestKernelCapacity:
 
     def test_wide_history_falls_back_to_cpu(self):
         # Window beyond MAX_SLOTS (129 crashed chained cas ops): auto mode
-        # must still answer via the unbounded CPU twin.
+        # must still answer via the unbounded CPU twin. The read observes
+        # the chain TIP so the dead-crashed-op prune keeps every link
+        # (each to-value is observed downstream) and the window really
+        # exceeds the kernel cap.
         rows = []
         for i in range(MAX_SLOTS + 2):
             rows.append(Op(i, INVOKE, "cas", (i, i + 1)))
         rows.append(Op(300, INVOKE, "read", None))
-        rows.append(Op(300, OK, "read", 5))
+        rows.append(Op(300, OK, "read", MAX_SLOTS + 2))
         seed = [Op(400, INVOKE, "write", 0), Op(400, OK, "write", 0)]
         hist = seed + rows
         r = LinearizableChecker(CasRegister(), algorithm="auto",

@@ -16,6 +16,10 @@ from pathlib import Path
 
 from util import free_port
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 REPO = Path(__file__).resolve().parent.parent
 
 

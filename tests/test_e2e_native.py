@@ -15,6 +15,8 @@ from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
                                                   LocalRaftDB)
 from jepsen_jgroups_raft_tpu.history.ops import NEMESIS, OK
 
+pytestmark = pytest.mark.slow
+
 NODES = ["n1", "n2", "n3"]
 
 

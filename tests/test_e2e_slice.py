@@ -36,6 +36,8 @@ from jepsen_jgroups_raft_tpu.nemesis.base import Nemesis
 from jepsen_jgroups_raft_tpu.sut.inmemory import InMemoryCluster, LatencyPlan
 from jepsen_jgroups_raft_tpu.workload import WORKLOADS
 
+pytestmark = pytest.mark.slow
+
 NODES = ["n1", "n2", "n3", "n4", "n5"]
 
 

@@ -12,6 +12,8 @@ from jepsen_jgroups_raft_tpu.history.ops import OK, History, Op
 from jepsen_jgroups_raft_tpu.models.leader import (LeaderModel,
                                                    MajorityLeaderModel)
 
+import pytest  # noqa: E402
+
 
 def _h(rows):
     h = History()
@@ -93,6 +95,7 @@ def test_inspect_safety_still_applies():
     assert LeaderModel().check(h)["valid?"] is False
 
 
+@pytest.mark.slow
 def test_e2e_election_with_views_on_real_cluster(tmp_path):
     """Full stack: local 3-node raft cluster, election workload with the
     views probe mixed in, a kill mid-run to force re-election — the

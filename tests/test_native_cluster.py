@@ -18,6 +18,8 @@ from jepsen_jgroups_raft_tpu.native.client import (NativeCounterConn,
                                                    NativeLeaderConn,
                                                    NativeRsmConn)
 
+pytestmark = pytest.mark.slow
+
 NODES = ["n1", "n2", "n3"]
 
 

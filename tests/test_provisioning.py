@@ -22,6 +22,8 @@ from pathlib import Path
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 PROVISION = Path(__file__).resolve().parent.parent / "provision"
 
 # The in-container run line — kept short: 3 workers, counter workload

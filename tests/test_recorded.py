@@ -15,6 +15,10 @@ from jepsen_jgroups_raft_tpu.cli import main as cli_main
 
 from test_e2e_native import run_native_test
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 
 def test_recorded_runs_reverify_as_device_batch(tmp_path, capsys):
     # Two real cluster runs: multi-register (independent keys → many

@@ -23,6 +23,10 @@ from jepsen_jgroups_raft_tpu.ops.segment_scan import (check_segmented_batch,
                                                       find_cuts,
                                                       plan_segments)
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
 
 def _h(rows):
     h = History()

@@ -65,6 +65,8 @@ def shimmed_path(tmp_path, monkeypatch):
 
 from util import free_port as _free_port  # noqa: E402  (shared helper)
 
+pytestmark = pytest.mark.slow
+
 
 def test_ssh_tier_full_lifecycle_executes(tmp_path, shimmed_path):
     remote_dir = str(tmp_path / "opt-raft")

@@ -23,6 +23,8 @@ from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
 from jepsen_jgroups_raft_tpu.native import NATIVE_DIR, ensure_built
 from jepsen_jgroups_raft_tpu.native.client import NativeConn, NativeRsmConn
 
+pytestmark = pytest.mark.slow
+
 NODES = ["n1", "n2", "n3"]
 
 MARKERS = {
