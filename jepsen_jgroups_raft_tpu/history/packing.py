@@ -189,9 +189,10 @@ def _prune_dead_crashed(model, opens: dict, forces: dict) -> None:
 def pad_batch_bucketed(events: np.ndarray, tables=(), floor_b: int = 8,
                        floor_e: Optional[int] = 32, multiple_b: int = 1):
     """Pad a packed [B, E, 5] batch (and optional per-history [B, X]
-    tables) to jit-cache-friendly shapes: B to the next power of two ≥
-    floor_b (then up to a multiple of multiple_b, for mesh sharding), E to
-    the next power of two ≥ floor_e (None keeps E). Pad rows are EV_PAD
+    tables) to jit-cache-friendly shapes: B to the next bucket of the
+    pow2+midpoint series ≥ floor_b (see `_bucket_pow2`; shapes like 12,
+    48, 96 occur) then up to a multiple of multiple_b for mesh sharding;
+    E likewise from floor_e (None keeps E exact). Pad rows are EV_PAD
     no-ops. Returns (events, tables_list, original_B) — the single home of
     the padding convention (checker and mesh both route through it)."""
     B, E = events.shape[0], events.shape[1]

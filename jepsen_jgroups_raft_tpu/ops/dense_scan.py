@@ -177,20 +177,25 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
             rest.append(i)
     groups: list = []
 
-    def flush(kind, pending, w):
+    def flush(kind, pending):
         """Emit (indices, plan) for one group, or None when the whole
-        group sheds. Domain mode re-checks the cell envelope: eligibility
-        used each history's own W and unpadded |domain|, but the merged
-        group launches at the widest W with S bucketed up to a power of
-        two — which can exceed the cap (e.g. stragglers merged into a
-        2^10 window with S padded 9→16 = 16384 cells, 2× the cap). The
-        widest histories shed to the sort ladder rather than launch an
+        group sheds. The launch window is always recomputed from the
+        group's OWN histories (never the loop's current bucket window —
+        an early flush of short stragglers before a wide long-history
+        bucket must not inherit the wide W; kernel cost is 2^W). Domain
+        mode additionally re-checks the cell envelope: eligibility used
+        each history's own W and unpadded |domain|, but the merged group
+        launches at the widest W with S bucketed up to a power of two —
+        which can exceed the cap (e.g. stragglers merged into a 2^10
+        window with S padded 9→16 = 16384 cells, 2× the cap). The widest
+        histories shed to the sort ladder rather than launch an
         oversized kernel."""
+        w_eff = max(max(encs[i].n_slots for i in pending), 1)
         if kind == "mask":
             return (pending, DensePlan(
-                "mask", w, 1, np.zeros((len(pending), 1), dtype=np.int32)))
+                "mask", w_eff, 1,
+                np.zeros((len(pending), 1), dtype=np.int32)))
         S, val_of = _pad_domains(domains, pending)
-        w_eff = max(max(encs[i].n_slots for i in pending), 1)
         while (1 << w_eff) * S > DENSE_MAX_CELLS and pending:
             widest = max(pending, key=lambda i: encs[i].n_slots)
             pending.remove(widest)
@@ -213,14 +218,14 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
                 # Flush accumulated short stragglers FIRST: merging them
                 # into the long launch would pad their event streams to
                 # the long history's length (E dominates kernel work).
-                g = flush(kind, pending, w)
+                g = flush(kind, pending)
                 if g is not None:
                     groups.append(g)
                 pending = []
             pending += bucket
             min_group = 1 if long_bucket else DENSE_MIN_GROUP
             if len(pending) >= min_group or w == windows[-1]:
-                g = flush(kind, pending, w)
+                g = flush(kind, pending)
                 if g is not None:
                     groups.append(g)
                 pending = []

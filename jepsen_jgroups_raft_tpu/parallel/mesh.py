@@ -138,7 +138,7 @@ def _real_mask(B_real: int, B_padded: int) -> np.ndarray:
 def _run_once(model, events: np.ndarray, mesh: Mesh, n_configs: int,
               n_slots: int):
     """One sharded launch at a fixed frontier capacity, with mesh-size
-    padding handled. B is bucketed to a power of two so escalation rungs
+    padding handled. B is bucketed (pow2+midpoint series) so escalation rungs
     (whose subset sizes vary run to run) hit the jit cache instead of
     recompiling per call."""
     axis_name = mesh.axis_names[0]

@@ -69,14 +69,16 @@ def write_histories(histories, out_dir: str) -> int:
     return len(histories)
 
 
-def north_star_histories():
+def north_star_histories(n: int = 1000):
+    """First `n` histories of bench.py's exact batch (same seed/params —
+    the comparison is only meaningful on identical inputs)."""
     import random
 
     from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
 
     rng = random.Random(20260729)  # bench.py's exact seed and shape
     out = []
-    for _ in range(1000):
+    for _ in range(n):
         h = random_valid_history(rng, "register", n_ops=1000, n_procs=5,
                                  crash_p=0.05, max_crashes=3)
         out.append([{"process": o.process, "type": o.type, "f": o.f,

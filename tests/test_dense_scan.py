@@ -291,3 +291,34 @@ def test_pinned_capacity_keeps_sort_kernel():
     rs = check_histories([h], CasRegister(), algorithm="jax", n_configs=64)
     assert rs[0]["valid?"] is True
     assert rs[0].get("kernel") == "sort"
+
+
+def test_early_flush_keeps_stragglers_window_snug():
+    """Regression: flushing short stragglers ahead of a long-history
+    bucket must launch them at THEIR OWN max window, not the long
+    bucket's (kernel cost is 2^W; inheriting the wide W silently
+    multiplied the stragglers' work)."""
+    import random
+
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.ops.dense_scan import (MERGE_MAX_EVENTS,
+                                                        dense_plans_grouped)
+
+    m = CasRegister()
+    rng = random.Random(4)
+    # A few short narrow histories (below DENSE_MIN_GROUP)...
+    short = [encode_history(
+        random_valid_history(rng, "register", n_ops=10, n_procs=2,
+                             crash_p=0.0), m) for _ in range(3)]
+    # ...plus one long wide history that triggers the early flush.
+    long_h = encode_history(
+        random_valid_history(rng, "register",
+                             n_ops=MERGE_MAX_EVENTS, n_procs=5,
+                             crash_p=0.03, max_crashes=3), m)
+    assert long_h.n_events > MERGE_MAX_EVENTS
+    encs = short + [long_h]
+    groups, rest = dense_plans_grouped(m, encs)
+    assert not rest
+    for idxs, plan in groups:
+        w_own = max(encs[i].n_slots for i in idxs)
+        assert plan.n_slots == max(w_own, 1), (idxs, plan.n_slots)

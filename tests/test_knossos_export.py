@@ -67,7 +67,7 @@ def test_north_star_export_is_benchs_batch(tmp_path):
               "value": list(o.value) if isinstance(o.value, tuple)
               else o.value, "index": i, "time": o.time}
              for i, o in enumerate(want)]
-    exported_first = export_edn.north_star_histories()[0]
+    [exported_first] = export_edn.north_star_histories(n=1)
     assert exported_first == first  # byte-identical batch, not just shape
     text = export_edn.history_edn(first)
     assert text.startswith("[{:process")
