@@ -13,20 +13,17 @@ from __future__ import annotations
 from itertools import combinations
 from typing import Sequence, Union
 
-from ..history.ops import History, Op, pair_ops
+from ..history.ops import History, Op, OpPair, pair_ops_indexed
 
 
 def check_brute(history: Union[History, Sequence[Op]], model) -> bool:
     ops = list(history)
-    pos = {id(op): i for i, op in enumerate(ops)}
-    items = []  # (inv_pos, res_pos, f, a, b, forced)
-    for pair in pair_ops(ops):
-        enc = model.encode_pair(pair)
+    items = []  # (inv_pos, res_pos, encoded)
+    for ip, cp, inv, comp in pair_ops_indexed(ops):
+        enc = model.encode_pair(OpPair(inv, comp))
         if enc is None:
             continue
-        inv = pos[id(pair.invoke)]
-        res = pos[id(pair.completion)] if enc.forced else float("inf")
-        items.append((inv, res, enc))
+        items.append((ip, cp if enc.forced else float("inf"), enc))
 
     forced = [it for it in items if it[2].forced]
     optional = [it for it in items if not it[2].forced]

@@ -38,7 +38,8 @@ from typing import Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .ops import NIL, History, Op, OpPair, pair_ops  # noqa: F401  (NIL re-exported)
+from .ops import (NIL, History, Op, OpPair,  # noqa: F401  (NIL re-exported)
+                  pair_ops, pair_ops_indexed)
 
 # Event types.
 EV_PAD = 0
@@ -83,20 +84,29 @@ def encode_history(
     """
 
     ops = list(history)
-    pairs = pair_ops(ops)
 
-    # Encode pairs; remember, per original-op position, what happens there.
+    # Pair + encode in one pass over indexed pairs (no identity maps —
+    # this is the batch-encode hot path; round-3 profile: ~85% of the
+    # suite wall was host encode before this was flattened).
     opens: dict = {}  # invoke position -> (pair, encoded)
     forces: dict = {}  # completion position -> invoke position
-    pos = {id(op): i for i, op in enumerate(ops)}
-    for pair in pairs:
+    for ip, cp, inv, comp in pair_ops_indexed(ops):
+        pair = OpPair(inv, comp)
         enc = model.encode_pair(pair)
         if enc is None:
             continue
-        ip = pos[id(pair.invoke)]
         opens[ip] = (pair, enc)
         if enc.forced:
-            forces[pos[id(pair.completion)]] = ip
+            # A forced op must HAVE a completion (forced = "completed
+            # ok, must linearize by then"); a model claiming forced for
+            # a crashed pair is inconsistent and must fail loudly, not
+            # silently drop the FORCE event (cp is -1 for crashed pairs
+            # and would never be visited by the event loop).
+            if cp < 0:
+                raise ValueError(
+                    f"model {type(model).__name__} encoded a pair with no "
+                    f"completion as forced (invoke index {inv.index})")
+            forces[cp] = ip
     if prune:
         _prune_dead_crashed(model, opens, forces)
 
