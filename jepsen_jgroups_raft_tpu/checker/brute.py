@@ -23,6 +23,12 @@ def check_brute(history: Union[History, Sequence[Op]], model) -> bool:
         enc = model.encode_pair(OpPair(inv, comp))
         if enc is None:
             continue
+        if enc.forced and cp < 0:
+            # Same inconsistency encode_history rejects: a forced op must
+            # have a completion; cp=-1 would order it before everything.
+            raise ValueError(
+                f"model {type(model).__name__} encoded a pair with no "
+                f"completion as forced (invoke index {inv.index})")
         items.append((ip, cp if enc.forced else float("inf"), enc))
 
     forced = [it for it in items if it[2].forced]

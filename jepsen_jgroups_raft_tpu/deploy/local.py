@@ -66,7 +66,8 @@ class LocalCluster:
     def __init__(self, names: Iterable[str], sm: str = "map",
                  workdir: Optional[str] = None, election_ms: int = 150,
                  heartbeat_ms: int = 50, repl_timeout_ms: int = 10000,
-                 host: str = "127.0.0.1", server_bin: Optional[str] = None):
+                 host: str = "127.0.0.1", server_bin: Optional[str] = None,
+                 compact_every: int = 0):
         ensure_built()
         self.server_bin = str(server_bin or SERVER_BIN)
         self.host = host
@@ -74,6 +75,7 @@ class LocalCluster:
         self.election_ms = election_ms
         self.heartbeat_ms = heartbeat_ms
         self.repl_timeout_ms = repl_timeout_ms
+        self.compact_every = compact_every
         self.workdir = Path(workdir or tempfile.mkdtemp(prefix="raft-sut-"))
         self.workdir.mkdir(parents=True, exist_ok=True)
         self.ports: Dict[str, Tuple[int, int]] = {}
@@ -120,7 +122,9 @@ class LocalCluster:
              "--sm", self.sm, "--log-dir", str(self.workdir / "raftlog"),
              "--election-ms", str(self.election_ms),
              "--heartbeat-ms", str(self.heartbeat_ms),
-             "--repl-timeout-ms", str(self.repl_timeout_ms)],
+             "--repl-timeout-ms", str(self.repl_timeout_ms)]
+            + (["--compact-every", str(self.compact_every)]
+               if self.compact_every else []),
             stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
         log.close()
         if wait:

@@ -1,15 +1,25 @@
-// File-based Raft log + persisted vote metadata.
+// File-based Raft log + persisted vote metadata + snapshot base.
 //
 // Capability equivalent of the reference SUT's
 // log_class="org.jgroups.protocols.raft.FileBasedLog" log_dir="/tmp"
 // (server/resources/raft.xml:59-61): entries survive process kill, which is
 // what turns the :kill nemesis into a crash-RECOVERY test (SURVEY.md §5.4).
+// Snapshot/compaction covers the upstream library's snapshot() surface
+// (jgroups-raft StateMachine read/writeContentFrom — the L0 capability the
+// serialize-only hooks mirrored before round 3).
 //
 // Layout under <dir>/<name>/:
 //   meta    — current_term u64 | voted_for str   (atomic tmp+rename rewrite)
-//   log     — append-only records: u32 len | u64 term | u8 type | data
+//   snap    — base_index u64 | base_term u64 | sm_state str | config str
+//             (atomic tmp+rename; covers log prefix 1..base_index)
+//   log     — optional header (u32 0xFFFFFFFF | u64 start_index) then
+//             append-only records: u32 len | u64 term | u8 type | data.
+//             The header pins which absolute index the first record holds,
+//             so a crash between snap-write and log-rewrite is recoverable
+//             (stale prefix records below the snapshot base are skipped).
 // Conflict truncation rewrites the log file (rare; fine at harness scale).
-// Indexing is 1-based like the Raft paper; index 0 = empty-log sentinel.
+// Indexing is 1-based like the Raft paper; index 0 = empty-log sentinel;
+// with a snapshot, indices 1..base_index live only in the snapshot.
 #pragma once
 
 #include <cerrno>
@@ -44,6 +54,7 @@ class RaftLog {
     ::mkdir(dir.c_str(), 0755);
     ::mkdir(dir_.c_str(), 0755);
     load_meta();
+    load_snapshot();
     load_entries();
   }
 
@@ -56,34 +67,83 @@ class RaftLog {
     persist_meta();
   }
 
-  uint64_t last_index() const { return entries_.size(); }
+  uint64_t last_index() const { return base_index_ + entries_.size(); }
+  uint64_t base_index() const { return base_index_; }
+  uint64_t base_term() const { return base_term_; }
+  bool has_snapshot() const { return base_index_ > 0; }
+  const Bytes& snapshot_state() const { return snap_state_; }
+  const Bytes& snapshot_config() const { return snap_config_; }
+
   uint64_t term_at(uint64_t index) const {
-    if (index == 0 || index > entries_.size()) return 0;
-    return entries_[index - 1].term;
+    if (index == base_index_) return base_term_;
+    if (index <= base_index_ || index > last_index()) return 0;
+    return entries_[index - base_index_ - 1].term;
   }
-  const LogEntry& at(uint64_t index) const { return entries_[index - 1]; }
+  const LogEntry& at(uint64_t index) const {
+    return entries_[index - base_index_ - 1];
+  }
 
   uint64_t append(LogEntry e) {
     entries_.push_back(std::move(e));
     persist_append(entries_.back());
-    return entries_.size();
+    return last_index();
   }
 
   // Drop every entry with index >= from_index (conflict resolution).
+  // Entries at or below the snapshot base are committed-and-applied on
+  // this node; Raft safety says they can never conflict — refuse.
   void truncate_from(uint64_t from_index) {
-    if (from_index > entries_.size()) return;
-    entries_.resize(from_index - 1);
+    if (from_index > last_index() || from_index <= base_index_) return;
+    entries_.resize(from_index - base_index_ - 1);
+    rewrite();
+  }
+
+  // Fold the applied prefix 1..upto into a snapshot (sm_state = the state
+  // machine serialized AT upto; config = cluster config as of upto) and
+  // drop those entries. Ordering: the snapshot file lands (atomically)
+  // BEFORE the log rewrite — a crash in between leaves a log whose header
+  // says "starts at 1" next to a snap at base=upto, and load_entries
+  // skips the stale prefix records.
+  void compact(uint64_t upto, Bytes sm_state, Bytes config) {
+    if (upto <= base_index_ || upto > last_index()) return;
+    base_term_ = term_at(upto);
+    entries_.erase(entries_.begin(),
+                   entries_.begin() +
+                       static_cast<long>(upto - base_index_));
+    base_index_ = upto;
+    snap_state_ = std::move(sm_state);
+    snap_config_ = std::move(config);
+    persist_snapshot();
+    rewrite();
+  }
+
+  // Replace the entire log with a leader-sent snapshot (InstallSnapshot).
+  void install_snapshot(uint64_t idx, uint64_t term, Bytes sm_state,
+                        Bytes config) {
+    entries_.clear();
+    base_index_ = idx;
+    base_term_ = term;
+    snap_state_ = std::move(sm_state);
+    snap_config_ = std::move(config);
+    persist_snapshot();
     rewrite();
   }
 
  private:
+  static constexpr uint32_t kLogHeaderMagic = 0xFFFFFFFFu;
+
   std::vector<LogEntry> entries_;
   uint64_t current_term_ = 0;
+  uint64_t base_index_ = 0;  // snapshot covers 1..base_index_
+  uint64_t base_term_ = 0;
+  Bytes snap_state_;
+  Bytes snap_config_;
   std::string voted_for_;
   std::string dir_;  // empty → ephemeral
 
   std::string meta_path() const { return dir_ + "/meta"; }
   std::string log_path() const { return dir_ + "/log"; }
+  std::string snap_path() const { return dir_ + "/snap"; }
 
   // Durability: votes and entries are fsync'd (file AND directory) before
   // they are acted on — a persisted vote/append must survive not just
@@ -175,6 +235,12 @@ class RaftLog {
     std::string tmp = log_path() + ".tmp";
     int f = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
     if (f < 0) die("log rewrite open failed");
+    if (base_index_ > 0) {
+      Buf hdr;  // pin the absolute index of the first record
+      hdr.u32(kLogHeaderMagic);
+      hdr.u64(base_index_ + 1);
+      write_all(f, hdr.s);
+    }
     for (const auto& e : entries_) write_all(f, encode_entry(e));
     if (::fsync(f) != 0) die("log rewrite fsync failed");
     ::close(f);
@@ -183,22 +249,84 @@ class RaftLog {
     fsync_dir();
   }
 
+  void persist_snapshot() {
+    if (dir_.empty()) return;
+    Buf b;
+    b.u64(base_index_);
+    b.u64(base_term_);
+    b.str(snap_state_);
+    b.str(snap_config_);
+    std::string tmp = snap_path() + ".tmp";
+    int f = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (f < 0) die("snap open failed");
+    write_all(f, b.s);
+    if (::fsync(f) != 0) die("snap fsync failed");
+    ::close(f);
+    if (::rename(tmp.c_str(), snap_path().c_str()) != 0)
+      die("snap rename failed");
+    fsync_dir();
+  }
+
+  void load_snapshot() {
+    std::ifstream f(snap_path(), std::ios::binary);
+    if (!f) return;
+    std::string all((std::istreambuf_iterator<char>(f)),
+                    std::istreambuf_iterator<char>());
+    try {
+      Reader r(all);
+      base_index_ = r.u64();
+      base_term_ = r.u64();
+      snap_state_ = r.str();
+      snap_config_ = r.str();
+    } catch (const WireError&) {
+      // torn snapshot write never happens (tmp+rename), but a truncated
+      // file from a dying disk must not wedge recovery: fall back to the
+      // full log (which still covers everything if snap never landed).
+      base_index_ = base_term_ = 0;
+      snap_state_.clear();
+      snap_config_.clear();
+    }
+  }
+
   void load_entries() {
     std::ifstream f(log_path(), std::ios::binary);
     if (!f) return;
     std::string all((std::istreambuf_iterator<char>(f)),
                     std::istreambuf_iterator<char>());
     size_t off = 0;
+    uint64_t start_index = 1;  // headerless legacy files start at 1
+    if (all.size() >= 12) {
+      Reader hdr(all.data(), 12);
+      if (hdr.u32() == kLogHeaderMagic) {
+        start_index = hdr.u64();
+        off = 12;
+      }
+    }
+    if (start_index > base_index_ + 1) {
+      // The log header proves a compaction at start_index-1 happened,
+      // but no (intact) snapshot covers that prefix — the snap file is
+      // corrupt or missing. Loading the tail at shifted indices would
+      // silently replay it onto empty state and diverge; fail-stop
+      // instead (same stance as persistence failure above).
+      errno = EIO;
+      die("log starts past snapshot base (snap file lost/corrupt)");
+    }
+    // Records below the snapshot base are a stale prefix from a crash
+    // between snapshot-write and log-rewrite: skip them.
+    uint64_t idx = start_index - 1;  // index of the last consumed record
     while (off + 4 <= all.size()) {
       Reader hdr(all.data() + off, 4);
       uint32_t len = hdr.u32();
       if (off + 4 + len > all.size()) break;  // torn tail record: drop
-      Reader r(all.data() + off + 4, len);
-      LogEntry e;
-      e.term = r.u64();
-      e.type = r.u8();
-      e.data = r.rest();
-      entries_.push_back(std::move(e));
+      ++idx;
+      if (idx > base_index_) {
+        Reader r(all.data() + off + 4, len);
+        LogEntry e;
+        e.term = r.u64();
+        e.type = r.u8();
+        e.data = r.rest();
+        entries_.push_back(std::move(e));
+      }
       off += 4 + len;
     }
   }

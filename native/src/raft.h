@@ -75,6 +75,10 @@ class RaftNode {
     int heartbeat_ms = 100;
     int repl_timeout_ms = 30000;  // server repl-timeout analogue (30 s,
                                   // server/src/jgroups/raft/server.clj:37)
+    int compact_threshold = 0;  // fold the applied prefix into a snapshot
+                                // once it exceeds this many entries
+                                // (0 = compaction off — pre-round-3
+                                // behavior, unbounded log)
     std::vector<MemberSpec> initial_members;
   };
 
@@ -90,15 +94,15 @@ class RaftNode {
     {
       std::lock_guard<std::mutex> g(mu_);
       log_.open(opt_.log_dir, opt_.name);
-      config_ = opt_.initial_members;
-      // Recovered log may contain a newer committed config; adopt the last.
-      for (uint64_t i = log_.last_index(); i >= 1; --i) {
-        if (log_.at(i).type == wire::E_CONFIG) {
-          config_ = decode_config(log_.at(i).data);
-          break;
-        }
+      if (log_.has_snapshot()) {
+        // Restore the state machine from the snapshot and resume the
+        // apply cursor past the compacted prefix — the crash-recovery
+        // contract with compaction on (SURVEY.md §5.4).
+        std::istringstream in(log_.snapshot_state());
+        sm_->load(in);
+        commit_index_ = last_applied_ = log_.base_index();
       }
-      sync_transport_addresses();
+      reconfig_from_log_locked();
       reset_election_deadline();
     }
     running_ = true;
@@ -180,6 +184,12 @@ class RaftNode {
       }
       case wire::P_FWD_RESP:
         handle_fwd_resp(r);
+        break;
+      case wire::P_SNAP_REQ:
+        handle_snap_req(r);
+        break;
+      case wire::P_SNAP_RESP:
+        handle_snap_resp(r);
         break;
       default:
         break;  // unknown message from a newer version: ignore
@@ -458,6 +468,29 @@ class RaftNode {
       if (m.name == opt_.name) continue;
       uint64_t next = next_index_.count(m.name) ? next_index_[m.name]
                                                 : log_.last_index() + 1;
+      if (next <= log_.base_index()) {
+        // The follower is behind the compacted prefix: entries it needs
+        // no longer exist — ship the snapshot instead (InstallSnapshot,
+        // Raft §7; the catch-up path a freshly added member takes when
+        // it joins after compaction).
+        Buf b;
+        b.u8(wire::P_SNAP_REQ);
+        b.u64(log_.current_term());
+        b.str(opt_.name);
+        b.u64(log_.base_index());
+        b.u64(log_.base_term());
+        b.str(log_.snapshot_state());
+        b.str(log_.snapshot_config());
+        out.emplace_back(m.name, b.s);
+        // Optimistically advance past the base so the next heartbeat
+        // sends (cheap) appends instead of re-copying the full snapshot
+        // state every tick. If the snapshot frame is lost, the appends'
+        // prev-check fails, the follower's match hint walks next_index
+        // back below the base, and the snapshot naturally resends —
+        // a response-driven retry loop, not blind per-tick spam.
+        next_index_[m.name] = log_.base_index() + 1;
+        continue;
+      }
       uint64_t prev = next - 1;
       uint64_t last = std::min(log_.last_index(), prev + kMaxBatch);
       Buf b;
@@ -570,6 +603,70 @@ class RaftNode {
     if (resend) broadcast_append();
   }
 
+  void handle_snap_req(Reader& r) {
+    uint64_t term = r.u64();
+    std::string leader = r.str();
+    uint64_t bidx = r.u64();
+    uint64_t bterm = r.u64();
+    Bytes state = r.str();
+    Bytes config = r.str();
+    Buf resp;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      uint64_t my_term = log_.current_term();
+      uint64_t match = 0;
+      if (term >= my_term) {
+        if (term > my_term || role_ != Role::Follower) step_down_locked(term);
+        leader_hint_ = leader;
+        reset_election_deadline();
+        if (bidx > commit_index_) {
+          // Adopt wholesale: the snapshot covers strictly more than we
+          // have committed, so nothing it replaces can conflict with a
+          // commitment of ours. Uncommitted local entries it replaces
+          // were never acknowledged (Raft §7).
+          log_.install_snapshot(bidx, bterm, state, config);
+          std::istringstream in(state);
+          sm_->load(in);
+          commit_index_ = bidx;
+          last_applied_ = bidx;
+          config_ = decode_config(config);
+          sync_transport_addresses();
+        }
+        // Committed prefixes agree, so claiming bidx is safe even when we
+        // were already past it (the leader just advances next_index and
+        // verifies everything above it with ordinary AppendEntries).
+        match = bidx;
+      }
+      resp.u8(wire::P_SNAP_RESP);
+      resp.u64(log_.current_term());
+      resp.str(opt_.name);
+      resp.u64(match);
+    }
+    tr_->send(leader, resp.s);
+  }
+
+  void handle_snap_resp(Reader& r) {
+    uint64_t term = r.u64();
+    std::string follower = r.str();
+    uint64_t match = r.u64();
+    bool resend = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (term > log_.current_term()) {
+        step_down_locked(term);
+        return;
+      }
+      if (role_ != Role::Leader || term != log_.current_term()) return;
+      if (match > 0) {
+        match_index_[follower] = std::max(match_index_[follower], match);
+        next_index_[follower] = match_index_[follower] + 1;
+        maybe_advance_commit_locked();
+        resend = next_index_[follower] <= log_.last_index();
+      }
+    }
+    if (resend) broadcast_append();
+  }
+
   void maybe_advance_commit_locked() {
     if (role_ != Role::Leader) return;
     std::vector<uint64_t> matches;
@@ -611,6 +708,18 @@ class RaftNode {
             pending_.erase(it);
           }
         }
+        // Compaction: fold the applied prefix into a snapshot once it
+        // outgrows the threshold. Runs on every node independently (the
+        // applier owns both the SM and — under mu_ — the log), keeping
+        // disk and recovery time bounded on long kill/restart runs.
+        if (opt_.compact_threshold > 0 &&
+            last_applied_ - log_.base_index() >=
+                static_cast<uint64_t>(opt_.compact_threshold)) {
+          std::ostringstream os;
+          sm_->save(os);
+          log_.compact(last_applied_, os.str(),
+                       config_bytes_at_locked(last_applied_));
+        }
       }
       for (auto& [pend, res] : done) pend->promise.set_value(std::move(res));
     }
@@ -641,14 +750,29 @@ class RaftNode {
   }
 
   void reconfig_from_log_locked() {
+    // Precedence: last E_CONFIG among retained entries > the snapshot's
+    // config-at-base > the bootstrap member list.
     config_ = opt_.initial_members;
-    for (uint64_t i = log_.last_index(); i >= 1; --i) {
+    if (log_.has_snapshot() && !log_.snapshot_config().empty())
+      config_ = decode_config(log_.snapshot_config());
+    for (uint64_t i = log_.last_index(); i > log_.base_index(); --i) {
       if (log_.at(i).type == wire::E_CONFIG) {
         config_ = decode_config(log_.at(i).data);
         break;
       }
     }
     sync_transport_addresses();
+  }
+
+  // Cluster config as of log position `idx` (for snapshot metadata): the
+  // last E_CONFIG at or below idx, else the current snapshot's config,
+  // else the bootstrap list.
+  Bytes config_bytes_at_locked(uint64_t idx) const {
+    for (uint64_t i = idx; i > log_.base_index(); --i)
+      if (log_.at(i).type == wire::E_CONFIG) return log_.at(i).data;
+    if (log_.has_snapshot() && !log_.snapshot_config().empty())
+      return log_.snapshot_config();
+    return encode_config(opt_.initial_members);
   }
 
   void sync_transport_addresses() {

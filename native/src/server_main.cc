@@ -41,6 +41,8 @@ struct Flags {
   int election_ms = 300;
   int heartbeat_ms = 100;
   int repl_timeout_ms = 30000;
+  int compact_every = 0;  // snapshot+compact after this many applied
+                          // entries (0 = off)
 };
 
 Flags parse_flags(int argc, char** argv) {
@@ -68,6 +70,8 @@ Flags parse_flags(int argc, char** argv) {
       f.heartbeat_ms = std::stoi(next());
     else if (a == "--repl-timeout-ms")
       f.repl_timeout_ms = std::stoi(next());
+    else if (a == "--compact-every")
+      f.compact_every = std::stoi(next());
     else {
       fprintf(stderr, "unknown flag: %s\n", a.c_str());
       exit(2);
@@ -77,7 +81,8 @@ Flags parse_flags(int argc, char** argv) {
     fprintf(stderr,
             "usage: raft_server --name N --members a=h:cp:pp,... "
             "[--sm map|counter|election] [--log-dir D] [--election-ms MS] "
-            "[--heartbeat-ms MS] [--repl-timeout-ms MS]\n");
+            "[--heartbeat-ms MS] [--repl-timeout-ms MS] "
+            "[--compact-every N]\n");
     exit(2);
   }
   return f;
@@ -226,6 +231,7 @@ int main(int argc, char** argv) {
   opt.election_ms = f.election_ms;
   opt.heartbeat_ms = f.heartbeat_ms;
   opt.repl_timeout_ms = f.repl_timeout_ms;
+  opt.compact_threshold = f.compact_every;
   opt.initial_members = members;
   RaftNode raft(opt, sm, &tr);
   election_sm.attach(&raft);

@@ -86,6 +86,21 @@ class MapStateMachine : public StateMachine {
     out.write(b.s.data(), static_cast<std::streamsize>(b.s.size()));
   }
 
+  void load(std::istream& in) override {
+    std::string all((std::istreambuf_iterator<char>(in)),
+                    std::istreambuf_iterator<char>());
+    std::lock_guard<std::mutex> g(mu_);
+    map_.clear();
+    if (all.empty()) return;
+    Reader r(all);
+    uint32_t n = r.u32();
+    for (uint32_t i = 0; i < n; ++i) {
+      uint64_t k = r.u64();
+      int64_t v = r.i64();
+      map_[k] = v;
+    }
+  }
+
  private:
   Bytes encode_get(uint64_t key) {
     Buf b;
@@ -152,6 +167,31 @@ class CounterStateMachine : public StateMachine {
       return submit(op.s);
     }
     return submit(body);
+  }
+
+  void save(std::ostream& out) override {
+    std::lock_guard<std::mutex> g(mu_);
+    Buf b;
+    b.u32(static_cast<uint32_t>(counters_.size()));
+    for (const auto& [name, v] : counters_) {
+      b.str(name);
+      b.i64(v);
+    }
+    out.write(b.s.data(), static_cast<std::streamsize>(b.s.size()));
+  }
+
+  void load(std::istream& in) override {
+    std::string all((std::istreambuf_iterator<char>(in)),
+                    std::istreambuf_iterator<char>());
+    std::lock_guard<std::mutex> g(mu_);
+    counters_.clear();
+    if (all.empty()) return;
+    Reader r(all);
+    uint32_t n = r.u32();
+    for (uint32_t i = 0; i < n; ++i) {
+      std::string name = r.str();
+      counters_[name] = r.i64();
+    }
   }
 
  private:

@@ -60,6 +60,9 @@ constexpr uint8_t P_APP_REQ = 4;    // term, leader, prev_idx, prev_term,
 constexpr uint8_t P_APP_RESP = 5;   // term, success, follower, match/hint
 constexpr uint8_t P_FWD_REQ = 6;    // reqid, origin, sm body (REDIRECT analogue)
 constexpr uint8_t P_FWD_RESP = 7;   // reqid, ok, body-or-(errkind,msg)
+constexpr uint8_t P_SNAP_REQ = 8;   // term, leader, base_idx, base_term,
+                                    // sm_state, config (InstallSnapshot)
+constexpr uint8_t P_SNAP_RESP = 9;  // term, follower, match
 
 // raft log entry types
 constexpr uint8_t E_NOOP = 0;    // leader's term-opening no-op

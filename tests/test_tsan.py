@@ -113,6 +113,10 @@ def test_native_server_is_clean_under_sanitizer(tmp_path, san):
     cluster = LocalCluster(
         NODES, sm="map", workdir=str(tmp_path / "sut"),
         election_ms=300, heartbeat_ms=100, repl_timeout_ms=5000,
+        # Aggressive compaction so the snapshot/InstallSnapshot paths
+        # (applier-thread compaction, snapshot sends, SM save/load) run
+        # under the sanitizer too.
+        compact_every=8,
         server_bin=str(NATIVE_DIR / f"build-{san}" / "raft_server"))
     try:
         _run_faulted_workload(cluster)
