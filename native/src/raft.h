@@ -60,8 +60,12 @@ class StateMachine {
   // blocks for the replicated response (or error).
   virtual Result receive(const Bytes& body, const SubmitFn& submit) = 0;
   // Snapshot hooks (upstream readContentFrom/writeContentTo analogue,
-  // LeaderElection.java:52-55); log compaction is not exercised by the
-  // harness, so these only serialize state.
+  // LeaderElection.java:52-55). LOAD-BEARING since round 3: the applier
+  // compacts the applied prefix through save(), and crash-recovery /
+  // InstallSnapshot restore the replica through load() — a state machine
+  // with real state MUST override both, or snapshot restore silently
+  // yields an empty machine (the no-op default only suits stateless SMs
+  // like the election inspector).
   virtual void save(std::ostream&) {}
   virtual void load(std::istream&) {}
 };
@@ -588,10 +592,7 @@ class RaftNode {
       }
       if (role_ != Role::Leader || term != log_.current_term()) return;
       if (success) {
-        match_index_[follower] = std::max(match_index_[follower], match);
-        next_index_[follower] = match_index_[follower] + 1;
-        maybe_advance_commit_locked();
-        resend = next_index_[follower] <= log_.last_index();
+        resend = advance_follower_locked(follower, match);
       } else {
         uint64_t next = next_index_.count(follower) ? next_index_[follower]
                                                     : log_.last_index() + 1;
@@ -623,13 +624,25 @@ class RaftNode {
           // Adopt wholesale: the snapshot covers strictly more than we
           // have committed, so nothing it replaces can conflict with a
           // commitment of ours. Uncommitted local entries it replaces
-          // were never acknowledged (Raft §7).
-          log_.install_snapshot(bidx, bterm, state, config);
-          std::istringstream in(state);
-          sm_->load(in);
+          // were never acknowledged (Raft §7). FAIL-STOP on a corrupt
+          // state payload: the log is already mutated by the time load
+          // throws, so continuing would leave base_index_ ahead of a
+          // half-cleared state machine (and the applier indexing past
+          // an empty entries_ vector) — same stance as persistence
+          // failure in log.h.
+          try {
+            log_.install_snapshot(bidx, bterm, state, config);
+            std::istringstream in(state);
+            sm_->load(in);
+            config_ = decode_config(config);
+          } catch (const std::exception& e) {
+            std::fprintf(stderr,
+                         "[raft] FATAL: snapshot install failed: %s\n",
+                         e.what());
+            std::abort();
+          }
           commit_index_ = bidx;
           last_applied_ = bidx;
-          config_ = decode_config(config);
           sync_transport_addresses();
         }
         // Committed prefixes agree, so claiming bidx is safe even when we
@@ -657,14 +670,19 @@ class RaftNode {
         return;
       }
       if (role_ != Role::Leader || term != log_.current_term()) return;
-      if (match > 0) {
-        match_index_[follower] = std::max(match_index_[follower], match);
-        next_index_[follower] = match_index_[follower] + 1;
-        maybe_advance_commit_locked();
-        resend = next_index_[follower] <= log_.last_index();
-      }
+      if (match > 0) resend = advance_follower_locked(follower, match);
     }
     if (resend) broadcast_append();
+  }
+
+  // Shared follower-progress bookkeeping for successful APP and SNAP
+  // responses. Returns whether the follower still trails the log (the
+  // caller should trigger another append round).
+  bool advance_follower_locked(const std::string& follower, uint64_t match) {
+    match_index_[follower] = std::max(match_index_[follower], match);
+    next_index_[follower] = match_index_[follower] + 1;
+    maybe_advance_commit_locked();
+    return next_index_[follower] <= log_.last_index();
   }
 
   void maybe_advance_commit_locked() {

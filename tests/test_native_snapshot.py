@@ -129,6 +129,51 @@ def test_follower_catches_up_via_install_snapshot(tmp_path):
         cluster.shutdown()
 
 
+def test_new_member_joins_via_install_snapshot(tmp_path):
+    """The hardest catch-up path: a member added AFTER compaction was
+    never in the initial config and owns none of the compacted entries —
+    its only route to the cluster state is the leader's InstallSnapshot
+    (which must also carry the config so the joiner learns the
+    membership it is part of)."""
+    from jepsen_jgroups_raft_tpu.deploy.local import LocalRaftDB
+
+    cluster = LocalCluster(NODES, sm="map", workdir=str(tmp_path),
+                           election_ms=150, heartbeat_ms=50,
+                           compact_every=16)
+    try:
+        for n in NODES:
+            cluster.start_node(n, NODES)
+        # Push well past the threshold so the prefix the joiner would
+        # need is long gone everywhere.
+        _put_many(cluster, 48)
+        assert _wait(lambda: len(_snap_files(cluster)) == 3)
+
+        test = {"nodes": NODES, "members": set(NODES)}
+        db = LocalRaftDB(cluster, seed=2)
+        db.add_member(test, "n4")       # consensus add (grow! ordering,
+        test["members"].add("n4")       # membership.clj:47-70)
+        db.start(test, "n4")
+
+        c4 = _conn(cluster, "n4")
+        try:
+            # Pre-join data served from n4's own state: only the
+            # snapshot could have carried it.
+            assert _wait(lambda: c4.get(7, quorum=False) == 1007,
+                         timeout=15.0)
+            # And the joiner knows the 4-member config (shipped inside
+            # the snapshot / retained E_CONFIG).
+            admin = cluster.admin("n4")
+            try:
+                assert _wait(lambda: len(admin.admin_members()) == 4,
+                             timeout=10.0)
+            finally:
+                admin.close()
+        finally:
+            c4.close()
+    finally:
+        cluster.shutdown()
+
+
 def test_e2e_register_run_valid_under_compaction(tmp_path):
     """Full harness run with aggressive compaction + kill nemesis: the
     recorded history must still check linearizable — compaction must be
