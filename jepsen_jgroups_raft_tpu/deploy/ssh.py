@@ -50,17 +50,21 @@ def _paths(remote_dir: str):
 def start_daemon_cmd(name: str, members_arg: str, sm: str,
                      election_ms: int, heartbeat_ms: int,
                      repl_timeout_ms: int,
-                     remote_dir: str = REMOTE_DIR) -> str:
+                     remote_dir: str = REMOTE_DIR,
+                     compact_every: int = 0) -> str:
     """Daemonize with nohup + pid file + log redirect (start-daemon!
     analogue). Idempotent: refuses if the pid file points at a live
     process (server.clj:143-146)."""
     rbin, rlog, rpid = _paths(remote_dir)
-    args = " ".join(shlex.quote(a) for a in [
+    argv = [
         rbin, "--name", name, "--members", members_arg, "--sm", sm,
         "--log-dir", f"{remote_dir}/raftlog",
         "--election-ms", str(election_ms),
         "--heartbeat-ms", str(heartbeat_ms),
-        "--repl-timeout-ms", str(repl_timeout_ms)])
+        "--repl-timeout-ms", str(repl_timeout_ms)]
+    if compact_every:
+        argv += ["--compact-every", str(compact_every)]
+    args = " ".join(shlex.quote(a) for a in argv)
     return (f"mkdir -p {remote_dir}/raftlog; "
             f"if [ -f {rpid} ] && kill -0 $(cat {rpid}) "
             f"2>/dev/null; then echo already-running; else "
@@ -181,7 +185,8 @@ class RemoteRaftCluster:
                  log_download_dir: Optional[str] = None,
                  remote_dir: str = REMOTE_DIR,
                  client_port: int = CLIENT_PORT,
-                 peer_port: int = PEER_PORT):
+                 peer_port: int = PEER_PORT,
+                 compact_every: int = 0):
         ensure_built()
         self.nodes = list(nodes)
         self.sm = sm
@@ -191,6 +196,7 @@ class RemoteRaftCluster:
         self.election_ms = election_ms
         self.heartbeat_ms = heartbeat_ms
         self.repl_timeout_ms = repl_timeout_ms
+        self.compact_every = compact_every
         self.remotes: Dict[str, SshRemote] = {
             n: SshRemote(n, user=ssh_user, key=ssh_key) for n in self.nodes}
         self.installed: set = set()
@@ -231,7 +237,8 @@ class RemoteRaftCluster:
         out = self.remote(name).exec(start_daemon_cmd(
             name, self.members_arg(set(members) | {name}), self.sm,
             self.election_ms, self.heartbeat_ms, self.repl_timeout_ms,
-            remote_dir=self.remote_dir))
+            remote_dir=self.remote_dir,
+            compact_every=self.compact_every))
         return out.stdout.strip()
 
     def kill_node(self, name: str) -> None:

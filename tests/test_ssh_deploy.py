@@ -24,7 +24,14 @@ def test_start_daemon_cmd_is_idempotent_and_daemonized():
     assert "already-running" in cmd
     assert "nohup" in cmd and REMOTE_BIN in cmd
     assert "--sm map" in cmd
+    assert "--compact-every" not in cmd  # off by default
     assert "echo $! > " + REMOTE_PID in cmd
+
+
+def test_start_daemon_cmd_carries_compaction_flag():
+    cmd = start_daemon_cmd("n1", "n1=n1:9000:9100", "map",
+                           300, 100, 30000, compact_every=512)
+    assert "--compact-every 512" in cmd
 
 
 def test_kill_cmd_loops_until_dead():
