@@ -312,11 +312,19 @@ def test_wide_window_with_info_ops_auto_stays_on_device():
     rows.append(Op(600, OK, "read", 50))  # chain fully linearized
     for i in range(50):
         rows.append(Op(i, INFO, "cas", (i, i + 1)))
+    # auto now tries a budgeted DFS first on wide windows (measured
+    # ~2000× faster on wide valid histories, round-3 soak) — it must
+    # DECIDE, whichever engine answers.
     results = check_histories([rows], CasRegister(), algorithm="auto",
                               n_configs=256)
     assert results[0]["valid?"] is True
-    assert results[0]["algorithm"] == "jax"
+    assert results[0]["algorithm"] in ("jax", "dfs")
     assert results[0]["concurrency-window"] > 31
+    # And the on-device sort kernel itself can still decide it when
+    # asked explicitly (the capability this test originally pinned).
+    [r] = check_histories([rows], CasRegister(), algorithm="jax",
+                          n_configs=256)
+    assert r["valid?"] is True and r["algorithm"] == "jax"
 
 
 def test_prune_decides_chained_crashed_cas_cheaply():
