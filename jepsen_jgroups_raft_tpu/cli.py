@@ -92,6 +92,10 @@ def _add_test_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--repl-timeout-ms", type=int, default=30000,
                    help="server-side replication timeout "
                         "(server/src/jgroups/raft/server.clj:37)")
+    p.add_argument("--compact-every", type=int, default=0,
+                   help="server snapshots + compacts its log after this "
+                        "many applied entries (0 = off); lagging/new "
+                        "members catch up via InstallSnapshot")
 
 
 def _nodes_from(args) -> list:
@@ -118,14 +122,16 @@ def _build_deployment(args, nodes):
             nodes, sm=sm, ssh_user=args.ssh_user,
             ssh_key=args.ssh_private_key,
             election_ms=args.election_ms, heartbeat_ms=args.heartbeat_ms,
-            repl_timeout_ms=args.repl_timeout_ms)
+            repl_timeout_ms=args.repl_timeout_ms,
+            compact_every=args.compact_every)
         return (RemoteRaftDB(cluster), IptablesNet(cluster),
                 cluster.conn_factory(), cluster.shutdown)
     from .deploy.local import BlockNet, LocalCluster, LocalRaftDB
     cluster = LocalCluster(
         nodes, sm=sm, election_ms=args.election_ms,
         heartbeat_ms=args.heartbeat_ms,
-        repl_timeout_ms=args.repl_timeout_ms)
+        repl_timeout_ms=args.repl_timeout_ms,
+        compact_every=args.compact_every)
     return (LocalRaftDB(cluster), BlockNet(cluster), cluster.conn_factory(),
             cluster.shutdown)
 
