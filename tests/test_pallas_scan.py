@@ -163,23 +163,47 @@ print("TPU_PASS")
 """
 
 
+@pytest.mark.slow
 def test_pallas_on_tpu_if_available():
     """Mosaic-lowering validation on real hardware, auto-detected: the
     conftest pins this process to CPU, so the probe+run happens in a
     subprocess on the default backend. Skips only when no TPU is
-    reachable (backend missing, init failure, or a wedged tunnel — the
-    timeout guards the known hang mode). First proven green on a real
-    TPU v5e 2026-07-30 (see BASELINE.md)."""
+    reachable (backend missing, init failure, or a wedged tunnel).
+    First proven green on a real TPU v5e 2026-07-30 (see BASELINE.md).
+
+    Two-stage budget (round-3 lesson: the wedged tunnel is the NORMAL
+    failure mode and used to burn the full 420 s, stalling the whole
+    suite >590 s): a cheap backend probe with a short timeout first —
+    a healthy tunnel answers init in ~15 s, a wedged one hangs forever —
+    and only when a TPU actually answers spend the long differential
+    budget."""
     import subprocess
     import sys
 
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Probe budget: a healthy tunnel answered init in ~15 s every round-3
+    # measurement; 50 s keeps a wedged-tunnel suite stall under the
+    # VERDICT r3 bound (<60 s to skip). A genuinely slower-but-healthy
+    # init (bench.py sizes its own probe at 120 s) would skip here and
+    # lose optional hardware coverage — raise via env for such sessions.
+    probe_timeout = float(os.environ.get("JGRAFT_TPU_PROBE_TIMEOUT", "50"))
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=probe_timeout, env=env,
+            cwd=cwd)
+    except subprocess.TimeoutExpired:
+        pytest.skip(f"TPU backend probe timed out in {probe_timeout:.0f} s "
+                    "(tunnel wedged)")
+    if probe.returncode != 0 or "tpu" not in probe.stdout:
+        pytest.skip("no TPU attached (default backend: %s)"
+                    % (probe.stdout.strip() or probe.stderr[-200:]))
     try:
         out = subprocess.run(
             [sys.executable, "-c", _TPU_SUBPROCESS_CHECK],
-            capture_output=True, text=True, timeout=420, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+            capture_output=True, text=True, timeout=420, env=env, cwd=cwd)
     except subprocess.TimeoutExpired:
         pytest.skip("TPU backend init timed out (tunnel wedged)")
     if "NO_TPU" in out.stdout or (out.returncode != 0 and
