@@ -117,10 +117,24 @@ class RaftLog {
     rewrite();
   }
 
-  // Replace the entire log with a leader-sent snapshot (InstallSnapshot).
+  // Adopt a leader-sent snapshot (InstallSnapshot). Raft Fig. 13 rule 6:
+  // when our log still holds an entry matching the snapshot's last
+  // included (index, term), the suffix after it belongs to the same
+  // leader history — RETAIN it instead of discarding entries this node
+  // may already have acknowledged toward commit (round-3 advisor
+  // finding: wholesale discard was only safe because the transport is
+  // per-peer FIFO loss-only; retention removes that non-local
+  // dependency). Any mismatch (or no entry at idx) discards everything:
+  // the log diverged from the committed history the snapshot embodies.
   void install_snapshot(uint64_t idx, uint64_t term, Bytes sm_state,
                         Bytes config) {
-    entries_.clear();
+    if (idx <= base_index_) return;  // our snapshot already covers idx
+    if (idx < last_index() && term_at(idx) == term) {
+      entries_.erase(entries_.begin(),
+                     entries_.begin() + static_cast<long>(idx - base_index_));
+    } else {
+      entries_.clear();
+    }
     base_index_ = idx;
     base_term_ = term;
     snap_state_ = std::move(sm_state);

@@ -1,0 +1,100 @@
+// Unit-scale selftest for RaftLog's InstallSnapshot semantics
+// (Raft Fig. 13 rule 6 — retain the suffix after a matching last-included
+// entry) plus the persistence round-trip of a retained suffix. Built as
+// native/build/log_selftest and driven by tests/test_native_snapshot.py;
+// exits non-zero with a message on the first failed check. Capability
+// contract: the reference SUT's FileBasedLog + jgroups-raft snapshot
+// install (SURVEY.md §5.4); the retention rule is the round-3 advisor fix.
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "log.h"
+
+using raftnative::LogEntry;
+using raftnative::RaftLog;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+static LogEntry entry(uint64_t term, const char* data) {
+  LogEntry e;
+  e.term = term;
+  e.type = 0;
+  e.data = data;
+  return e;
+}
+
+static void fill(RaftLog& log) {
+  // Indices 1..5, terms 1,1,2,2,3.
+  log.append(entry(1, "a"));
+  log.append(entry(1, "b"));
+  log.append(entry(2, "c"));
+  log.append(entry(2, "d"));
+  log.append(entry(3, "e"));
+}
+
+int main(int argc, char** argv) {
+  // 1. Matching (index, term) at the snapshot point → suffix retained.
+  {
+    RaftLog log;
+    fill(log);
+    log.install_snapshot(3, 2, "S3", "cfg");
+    CHECK(log.base_index() == 3 && log.base_term() == 2);
+    CHECK(log.last_index() == 5);
+    CHECK(log.at(4).data == "d" && log.at(5).data == "e");
+    CHECK(log.term_at(4) == 2 && log.term_at(5) == 3);
+    CHECK(log.snapshot_state() == "S3");
+  }
+  // 2. Term mismatch at the snapshot point → whole log discarded.
+  {
+    RaftLog log;
+    fill(log);
+    log.install_snapshot(3, 7, "S3'", "cfg");
+    CHECK(log.base_index() == 3 && log.base_term() == 7);
+    CHECK(log.last_index() == 3);  // nothing retained
+  }
+  // 3. Snapshot at/past our last entry → log fully covered, discarded.
+  {
+    RaftLog log;
+    fill(log);
+    log.install_snapshot(9, 4, "S9", "cfg");
+    CHECK(log.base_index() == 9 && log.last_index() == 9);
+    log.install_snapshot(9, 4, "again", "cfg");  // idx <= base: no-op
+    CHECK(log.snapshot_state() == "S9");
+  }
+  // 4. Snapshot exactly at last_index with matching term: equivalent to
+  //    full coverage (empty suffix).
+  {
+    RaftLog log;
+    fill(log);
+    log.install_snapshot(5, 3, "S5", "cfg");
+    CHECK(log.base_index() == 5 && log.last_index() == 5);
+  }
+  // 5. Persistence round-trip: a retained suffix must survive reopen
+  //    (the rewrite's header pins base_index+1 as the first record).
+  if (argc > 1) {
+    std::string dir = argv[1];
+    {
+      RaftLog log;
+      log.open(dir, "selftest");
+      fill(log);
+      log.install_snapshot(3, 2, "S3", "cfg");
+    }
+    {
+      RaftLog log;
+      log.open(dir, "selftest");
+      CHECK(log.base_index() == 3 && log.base_term() == 2);
+      CHECK(log.last_index() == 5);
+      CHECK(log.at(4).data == "d" && log.at(5).data == "e");
+      CHECK(log.snapshot_state() == "S3");
+    }
+  }
+  std::printf("LOG_SELFTEST_PASS\n");
+  return 0;
+}

@@ -621,10 +621,11 @@ class RaftNode {
         leader_hint_ = leader;
         reset_election_deadline();
         if (bidx > commit_index_) {
-          // Adopt wholesale: the snapshot covers strictly more than we
-          // have committed, so nothing it replaces can conflict with a
-          // commitment of ours. Uncommitted local entries it replaces
-          // were never acknowledged (Raft §7). FAIL-STOP on a corrupt
+          // Adopt: the snapshot covers strictly more than we have
+          // committed, so nothing it replaces can conflict with a
+          // commitment of ours. The log keeps any suffix that matches
+          // the snapshot's last included (index, term) — Raft Fig. 13
+          // rule 6, see log.h install_snapshot. FAIL-STOP on a corrupt
           // state payload: the log is already mutated by the time load
           // throws, so continuing would leave base_index_ ahead of a
           // half-cleared state machine (and the applier indexing past

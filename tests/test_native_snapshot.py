@@ -295,3 +295,21 @@ def test_e2e_register_run_valid_under_compaction(tmp_path):
     res = test["results"]
     assert res["valid?"] is True, res
     assert SnapProbeDB.saw_snap  # compaction really happened mid-run
+
+
+def test_log_selftest_install_snapshot_retention(tmp_path):
+    """C++ unit selftest: InstallSnapshot retains the log suffix after a
+    matching last-included (index, term) — Raft Fig. 13 rule 6 — and
+    discards on mismatch/coverage; the retained suffix survives reopen.
+    (Round-3 advisor finding: wholesale discard leaned on the transport
+    being per-peer FIFO loss-only.)"""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log")],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    assert "LOG_SELFTEST_PASS" in out.stdout
