@@ -115,9 +115,15 @@ class Model:
     # some of them irrelevant and drop them before slot assignment) ----
 
     def enable_values(self, enc: EncodedOp):
-        """State values that linearizing this op can newly expose to
-        later ops (e.g. a register write's value), or None when the
-        model cannot answer — None disables pruning for this op."""
+        """EVERY state value that linearizing this op can set the state
+        to (e.g. a register write's value) — not merely the "new" ones:
+        an empty set is a load-bearing assertion that the op NEVER
+        changes state (the prune drops crashed ops with empty enable
+        sets outright, so an op that rewrites the current/initial value
+        must still list it). Return None when the model cannot answer —
+        None disables pruning for this op. (Round-3 advisor finding:
+        the earlier "newly expose" wording permitted a sound-looking
+        implementation that made the prune unsound.)"""
         return None
 
     def observe_values(self, enc: EncodedOp):

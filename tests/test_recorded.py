@@ -80,3 +80,30 @@ def test_recorded_check_flags_corruption(tmp_path):
     summary = check_recorded([d])
     assert summary["valid?"] is False
     assert summary["n-invalid"] >= 1
+
+
+def test_recorded_election_recheck_keeps_majority_invariant(tmp_path):
+    """A store whose live run used --majority-election carries `views`
+    ops; re-verification must apply the same cross-node invariant, not
+    silently weaken to the inspect-only parity model (round-3 advisor
+    finding). Two different leaders reported for one term across nodes
+    is invalid on recheck — while with no views ops the model degrades
+    to parity and passes."""
+    d = tmp_path / "store" / "maj" / "t1"
+    d.mkdir(parents=True)
+    ops = [
+        {"process": 0, "type": "invoke", "f": "views", "value": None,
+         "time": 0, "index": 0},
+        {"process": 0, "type": "ok", "f": "views",
+         "value": [["n1", "n1", 5]], "time": 1, "index": 1},
+        {"process": 1, "type": "invoke", "f": "views", "value": None,
+         "time": 2, "index": 2},
+        {"process": 1, "type": "ok", "f": "views",
+         "value": [["n2", "n2", 5]], "time": 3, "index": 3},
+    ]
+    (d / "history.jsonl").write_text(
+        "\n".join(json.dumps(o) for o in ops) + "\n")
+    (d / "test.json").write_text(json.dumps({"workload": "election"}))
+    summary = check_recorded([d])
+    assert summary["valid?"] is False
+    assert summary["n-invalid"] == 1
