@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Seeded full-stack `hell` soak — the repeatable form of the round-3
+campaign (110 hell runs, every history linearizable; BASELINE.md cites the
+exact command).
+
+Each iteration drives the COMPLETE stack the way the reference's own
+product run does (SURVEY.md §3.1: compose → runner → concurrent clients
+over real TCP → nemesis → checker): a real 5-node native raft cluster
+(raft_server processes), the full fault set (partitions, kills, pauses,
+membership churn — the reference's `hell` special, nemesis.clj:12-22),
+aggressive log compaction, and post-hoc verification of the recorded
+history through the production checker ladder. A run whose workload
+checker reports invalid is a consensus bug (or checker bug — the
+counterexample store dir is kept either way); unknown verdicts are
+reported as routing gaps.
+
+Seeding: run i uses --seed + i for BOTH the cluster fault schedule and the
+generator, so any failure reproduces with
+  python scripts/soak_hell.py --runs 1 --seed <failing-seed>
+
+Usage (round-3 scale ≈ 110 runs):
+  python scripts/soak_hell.py --runs 110
+Quick pass:
+  python scripts/soak_hell.py --runs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from jepsen_jgroups_raft_tpu.platform import pin_cpu  # noqa: E402
+
+WORKLOAD_SM = {"single-register": "map", "multi-register": "map",
+               "counter": "counter", "election": "election"}
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--runs", type=int, default=10)
+    p.add_argument("--seed", type=int, default=100)
+    p.add_argument("--workloads",
+                   default="single-register,multi-register,counter",
+                   help="comma list cycled across runs (default the three "
+                        "frontier-checked workloads; add election for the "
+                        "invariant checker)")
+    p.add_argument("--time-limit", type=float, default=10.0,
+                   help="main phase seconds per run (default 10)")
+    p.add_argument("--rate", type=float, default=60.0)
+    p.add_argument("--concurrency", type=int, default=10)
+    p.add_argument("--compact-every", type=int, default=24,
+                   help="log-compaction threshold (0 disables; default 24 "
+                        "keeps snapshot/InstallSnapshot paths under fire)")
+    p.add_argument("--nemesis", default="hell")
+    p.add_argument("--keep-stores", action="store_true",
+                   help="keep every run's store dir (default: only "
+                        "failures are kept)")
+    return p.parse_args(argv)
+
+
+def one_run(i: int, args, workload: str, workdir: Path) -> dict:
+    from jepsen_jgroups_raft_tpu.core.compose import compose_test
+    from jepsen_jgroups_raft_tpu.core.runner import run_test
+    from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
+                                                      LocalRaftDB)
+
+    seed = args.seed + i
+    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    cluster = LocalCluster(nodes, sm=WORKLOAD_SM[workload],
+                           workdir=str(workdir / "sut"),
+                           election_ms=150, heartbeat_ms=50,
+                           repl_timeout_ms=3000,
+                           compact_every=args.compact_every)
+    opts = {
+        "name": f"soak-hell-{i}", "nodes": nodes,
+        "workload": workload, "nemesis": args.nemesis,
+        "conn_factory": cluster.conn_factory(),
+        "rate": args.rate, "interval": 1.5,
+        "time_limit": args.time_limit, "quiesce": 1.0,
+        "operation_timeout": 2.0, "concurrency": args.concurrency,
+        "store_root": str(workdir / "store"),
+    }
+    test = compose_test(opts, db=LocalRaftDB(cluster, seed=seed),
+                        net=BlockNet(cluster), seed=seed)
+    try:
+        test = run_test(test)
+    finally:
+        cluster.shutdown()
+    res = test["results"]
+    wl = res.get("workload", {})
+    return {
+        "seed": seed,
+        "workload": workload,
+        "valid": wl.get("valid?"),
+        "ok_ops": sum(1 for op in test["history"] if op.type == "ok"),
+        "info_ops": sum(1 for op in test["history"] if op.type == "info"),
+        "store_dir": test["store_dir"],
+    }
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    pin_cpu(8)  # the checker side; the cluster is real processes either way
+    workloads = [w.strip() for w in args.workloads.split(",") if w.strip()]
+    for w in workloads:
+        if w not in WORKLOAD_SM:
+            print(f"unknown workload {w!r}", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    failures, unknowns = [], []
+    for i in range(args.runs):
+        workload = workloads[i % len(workloads)]
+        workdir = Path(tempfile.mkdtemp(prefix=f"soak-hell-{i}-"))
+        try:
+            r = one_run(i, args, workload, workdir)
+        except Exception as e:  # noqa: BLE001 — a wedged run is a finding
+            r = {"seed": args.seed + i, "workload": workload,
+                 "valid": None, "error": f"{type(e).__name__}: {e}",
+                 "store_dir": str(workdir)}
+        keep = args.keep_stores or r["valid"] is not True
+        if not keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+        if r["valid"] is True:
+            status = "ok"
+        elif r["valid"] is False:
+            status = "INVALID"
+            failures.append(r)
+        else:
+            status = "unknown/error"
+            (failures if r.get("error") else unknowns).append(r)
+        print(f"  run {i + 1}/{args.runs} seed={r['seed']} "
+              f"{workload}: {status}"
+              + (f" (kept {r['store_dir']})" if keep else ""), flush=True)
+
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "runs": args.runs, "nemesis": args.nemesis,
+        "failures": len(failures), "unknowns": len(unknowns),
+        "time_s": round(dt, 1), "seed": args.seed,
+        "workloads": workloads,
+    }))
+    for r in failures + unknowns:
+        print("FINDING:", json.dumps(r), file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
