@@ -89,6 +89,30 @@ class Model:
             return None
         return self._encode(pair)
 
+    def encode_pairs_columnar(self, pairs):
+        """Batch-encode indexed pairs ([(invoke_pos, completion_pos|-1,
+        invoke, completion|None)], the `pair_ops_indexed` output) into
+        parallel lists (fs, as_, bs, forced, invoke_pos, completion_pos)
+        of KEPT ops, or None to use the per-pair path.
+
+        This is the encode hot path (~85% of suite wall time was host
+        encode before round 3; round 4 removed the remaining per-op
+        dataclass+method-call overhead — ~7 µs/op → ~1 µs/op). A model
+        implementing it MUST produce exactly what a `encode_pair` loop
+        would (differential tests pin this), and must also define
+        `prune_observe_enable` consistently with its enable/observe
+        hooks: None there ⇔ the hooks disable pruning for this model.
+        """
+        return None
+
+    def prune_observe_enable(self, fs, as_, bs):
+        """Columnar twin of enable_values/observe_values for the fast
+        prune: (enable_val, enable_has, observe_val, observe_has) int32/
+        bool numpy arrays over the kept ops — valid only for models
+        whose enable/observe sets are at most singletons — or None when
+        the model's hooks disable pruning (the conservative default)."""
+        return None
+
     def dense_domain(self, events) -> Optional[list]:
         """Enumerate the reachable state-value domain of a packed history
         (events [E,5] int32, initial state FIRST), or None when the domain

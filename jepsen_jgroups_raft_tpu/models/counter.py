@@ -21,7 +21,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from ..history.ops import OK, OpPair
+from ..history.ops import FAIL, INFO, OK, OpPair
 from .base import EncodedOp, Model, _i32
 
 READ = 0
@@ -86,6 +86,48 @@ class Counter(Model):
             # unknown result: constrains nothing beyond the delta
             return EncodedOp(ADD, sign * _i32(pair.invoke.value), 0, False)
         raise ValueError(f"counter: unknown op f={f!r}")
+
+    def encode_pairs_columnar(self, pairs):
+        """Tight-loop twin of `_encode` (see Model.encode_pairs_columnar).
+        The counter model has no prune hooks (enable/observe inherit the
+        conservative None), so `prune_observe_enable` stays None — prune
+        is a no-op on both paths."""
+        fs, as_, bs = [], [], []
+        forced, ips, cps = [], [], []
+        i32 = _i32
+        for ip, cp, inv, comp in pairs:
+            ctype = comp.type if comp is not None else INFO
+            if ctype == FAIL:
+                continue
+            fo = ctype == OK
+            f = inv.f
+            sign = -1 if f in ("decr", "decr-and-get") else 1
+            if f in ("read", "get"):
+                if not fo:
+                    continue
+                fs.append(READ)
+                as_.append(i32(comp.value))
+                bs.append(0)
+            elif f in ("add", "decr"):
+                fs.append(ADD)
+                as_.append(sign * i32(inv.value))
+                bs.append(0)
+            elif f in ("add-and-get", "decr-and-get"):
+                if fo:
+                    delta, new = comp.value
+                    fs.append(ADD_AND_GET)
+                    as_.append(sign * i32(delta))
+                    bs.append(i32(new))
+                else:
+                    fs.append(ADD)
+                    as_.append(sign * i32(inv.value))
+                    bs.append(0)
+            else:
+                raise ValueError(f"counter: unknown op f={f!r}")
+            forced.append(fo)
+            ips.append(ip)
+            cps.append(cp)
+        return fs, as_, bs, forced, ips, cps
 
 
 def _wrap32(x: int) -> int:

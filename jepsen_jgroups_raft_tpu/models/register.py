@@ -19,7 +19,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from ..history.ops import OK, OpPair
+from ..history.ops import FAIL, INFO, OK, OpPair
 from .base import NIL, EncodedOp, Model, _i32
 
 READ = 0
@@ -108,3 +108,52 @@ class CasRegister(Model):
             frm, to = pair.invoke.value
             return EncodedOp(CAS, _i32(frm), _i32(to), forced)
         raise ValueError(f"cas-register: unknown op f={f!r}")
+
+    def encode_pairs_columnar(self, pairs):
+        """Tight-loop twin of `_encode` (see Model.encode_pairs_columnar;
+        differential tests pin the two byte-identical)."""
+        fs, as_, bs = [], [], []
+        forced, ips, cps = [], [], []
+        i32 = _i32
+        for ip, cp, inv, comp in pairs:
+            ctype = comp.type if comp is not None else INFO
+            if ctype == FAIL:
+                continue
+            fo = ctype == OK
+            f = inv.f
+            if f == "read":
+                if not fo:
+                    continue  # unknown read constrains nothing
+                fs.append(READ)
+                as_.append(i32(comp.value))
+                bs.append(0)
+            elif f == "write":
+                fs.append(WRITE)
+                as_.append(i32(inv.value))
+                bs.append(0)
+            elif f == "cas":
+                frm, to = inv.value
+                fs.append(CAS)
+                as_.append(i32(frm))
+                bs.append(i32(to))
+            else:
+                raise ValueError(f"cas-register: unknown op f={f!r}")
+            forced.append(fo)
+            ips.append(ip)
+            cps.append(cp)
+        return fs, as_, bs, forced, ips, cps
+
+    def prune_observe_enable(self, fs, as_, bs):
+        """Columnar enable/observe (singletons): write enables a, cas
+        enables b; read observes a, cas observes a (mirrors
+        enable_values/observe_values exactly)."""
+        import numpy as np
+
+        f = np.asarray(fs, dtype=np.int32)
+        a = np.asarray(as_, dtype=np.int32)
+        b = np.asarray(bs, dtype=np.int32)
+        enable_has = f != READ
+        enable_val = np.where(f == CAS, b, a)
+        observe_has = f != WRITE
+        observe_val = a
+        return enable_val, enable_has, observe_val, observe_has
