@@ -177,14 +177,19 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         batch = pack_batch(encs)
         t1 = time.perf_counter()
         n_valid = n_unknown = 0
-        for idxs, plan in grouped:
-            _, _, nv, nu = check_batch_sharded(
-                model, batch["events"][idxs], mesh, dense=plan)
-            n_valid += nv
-            n_unknown += nu
+        # Launch every window group, block once: over the TPU tunnel a
+        # blocking loop pays a network round trip per group.
+        finalizers = [
+            check_batch_sharded(model, batch["events"][idxs], mesh,
+                                dense=plan, defer=True)
+            for idxs, plan in grouped
+        ]
         if rest:
-            _, _, nv, nu = check_batch_sharded(
-                model, batch["events"][rest], mesh, n_slots=n_slots)
+            finalizers.append(check_batch_sharded(
+                model, batch["events"][rest], mesh, n_slots=n_slots,
+                defer=True))
+        for fin in finalizers:
+            _, _, nv, nu = fin()
             n_valid += nv
             n_unknown += nu
         t2 = time.perf_counter()
@@ -397,6 +402,11 @@ def resolve_platform() -> str:
         pin_cpu()
         return f"cpu ({'env-pinned' if env_pin else 'default backend'})"
     kind = "env-pinned" if env_pin else "default backend"
+    if env_pin and "cpu" not in os.environ["JAX_PLATFORMS"].split(","):
+        # Keep the host backend reachable next to the pinned TPU one:
+        # the checker's per-shape platform router sends tiny batches to
+        # the host mesh, which needs jax.devices("cpu") to resolve.
+        os.environ["JAX_PLATFORMS"] = os.environ["JAX_PLATFORMS"] + ",cpu"
     return f"{platform} ({kind}, probe ok{suffix})"
 
 
@@ -417,19 +427,23 @@ def main() -> None:
 def _is_backend_init_failure(e: BaseException) -> bool:
     """The round-2 failure mode: the platform probe succeeds but the
     in-process backend init then throws (tunnel dropped between probe and
-    init, or probe-OK/init-broken half-states)."""
-    text = f"{type(e).__name__}: {e}"
-    return ("Unable to initialize backend" in text
-            or "backend setup/compile error" in text
-            or "UNAVAILABLE" in text
-            or "DEADLINE_EXCEEDED" in text)
+    init, or probe-OK/init-broken half-states). Shared predicate lives in
+    platform.py so the checker's in-process degrade matches."""
+    from jepsen_jgroups_raft_tpu.platform import is_backend_init_failure
+
+    return is_backend_init_failure(e)
 
 
 def _reexec_on_cpu(e: BaseException) -> None:
     """Re-exec this bench pinned to CPU so the artifact carries a real
     measurement plus a degraded note — never value 0.0 (round-2 lesson:
-    that wasted the round's one driver bench). One retry only."""
-    env = dict(os.environ)
+    that wasted the round's one driver bench). One retry only. The
+    re-exec'd interpreter uses the disarmed-tunnel env: a wedged relay
+    hangs sitecustomize's axon registration at interpreter start, which
+    would turn the CPU fallback itself into an rc=124."""
+    from jepsen_jgroups_raft_tpu.platform import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
     env["JGRAFT_BENCH_PLATFORM"] = "cpu"
     env["JGRAFT_BENCH_DEGRADED"] = f"{type(e).__name__}: {e}"[:300]
     os.execve(sys.executable, [sys.executable] + sys.argv, env)

@@ -66,6 +66,39 @@ from .wgl_cpu import FrontierOverflow, check_encoded_cpu
 #: (reference doc/intro.md:35-41), but as a clean verdict, not an OOM.
 DEFAULT_MAX_CPU_CONFIGS = 1 << 18
 
+#: Per-shape platform routing (VERDICT r3 #4): with the chip behind a
+#: network tunnel, tiny dense batches are dominated by launch+transfer
+#: round trips, and the idle 8-way host mesh wins — measured on the
+#: config-3 shape (≈600 sub-histories of ≤33 events: CPU 2516 vs TPU
+#: 1391 hist/s, 2026-07-30 v5e) — while big batches amortize the trip
+#: (north star 1000×~1750 events: TPU 488 vs CPU 25.6). The gate is the
+#: group's scanned-cell count B×E; the default sits between the measured
+#: winners' shapes (config-3 ≈19k cells → host, config-4 ≈250k → TPU)
+#: and is env-tunable for re-ablation on other chip generations
+#: (doc/running.md "Re-tuning the measured gates").
+PLATFORM_ROUTE_MIN_CELLS = int(os.environ.get(
+    "JGRAFT_ROUTE_MIN_CELLS", str(64_000)))
+
+
+def _route_group_to_host(n_rows: int, n_events: int) -> bool:
+    """True when a dense window group should run on the host CPU backend
+    even though the default backend is a TPU. JGRAFT_PLATFORM_ROUTE
+    forces the answer (tpu|cpu); auto applies the measured cell gate."""
+    mode = os.environ.get("JGRAFT_PLATFORM_ROUTE", "auto")
+    if mode == "tpu":
+        return False
+    if mode == "cpu":
+        return True
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False  # already on the host — nothing to route
+    try:
+        jax.devices("cpu")
+    except RuntimeError:
+        return False  # cpu backend unavailable (JAX_PLATFORMS pinned)
+    return n_rows * n_events < PLATFORM_ROUTE_MIN_CELLS
+
 
 def check_histories(
     histories: Sequence[History],
@@ -116,9 +149,25 @@ def check_histories(
     if algorithm in ("jax", "auto", "pallas"):
         undecided = [e if results[i] is None else None
                      for i, e in enumerate(encs)]
-        jax_res = _jax_pass(
-            [e for e in undecided if e is not None], model, n_configs,
-            n_slots, kernel="pallas" if algorithm == "pallas" else None)
+        todo = [e for e in undecided if e is not None]
+        want_pallas = "pallas" if algorithm == "pallas" else None
+        try:
+            jax_res = _jax_pass(todo, model, n_configs, n_slots,
+                                kernel=want_pallas)
+        except Exception as e:
+            # An env-pinned backend that cannot initialize (JAX_PLATFORMS
+            # names a TPU plugin whose registration was skipped) or whose
+            # tunnel drops mid-flight must degrade to the host, not
+            # surface as an unknown-verdict checker crash — the bench
+            # learned this in round 2; round 4's /verify drive caught the
+            # library path. Same predicate as the bench's re-exec.
+            from ..platform import is_backend_init_failure, pin_cpu
+
+            if not is_backend_init_failure(e):
+                raise
+            pin_cpu()
+            jax_res = _jax_pass(todo, model, n_configs, n_slots,
+                                kernel=want_pallas)
         it = iter(jax_res)
         results = [r if r is not None else next(it) for r in results]
         if algorithm in ("jax", "pallas"):
@@ -228,42 +277,79 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                          if n_configs is None and n_slots is None
                          else ([], list(range(len(fits)))))
         if grouped:
-            for idxs, plan in grouped:
-                sub = [fits[j] for j in idxs]
-                batch = pack_batch([encs[i] for i in sub])
-                # Bucketing trades padding work for jit-cache stability.
-                # For a FEW LONG histories the trade inverts: padding a
-                # 2-history 16k-event group to 8 rows quadruples its
-                # kernel time, while the compile cache only ever sees a
-                # handful of such launches — use exact shapes there.
-                e_len = batch["events"].shape[1]
-                exact = (e_len > MERGE_MAX_EVENTS and len(sub) <= 16)
-                ev, (val_of,), B = pad_batch_bucketed(
-                    batch["events"], (plan.val_of,),
-                    floor_b=len(sub) if exact else 8,
-                    floor_e=None if exact else 32)
-                tag = plan.kernel_tag
-                if want_pallas and plan.kind == "domain":
-                    # Pallas path (ops/pallas_scan.py): same search,
-                    # frontier pinned in VMEM. Interpret off-TPU.
-                    import jax
+            # Launch every window group BEFORE blocking on any result:
+            # jax dispatch is async, so the device pipelines the groups
+            # while the host packs the next one — and when the chip sits
+            # behind a network tunnel (this build's deployment), blocking
+            # per group would serialize a full round trip per window
+            # group (VERDICT r3 #3: the config-4 end-to-end gap was
+            # launch-loop overhead, not kernel time).
+            t0 = time.perf_counter()
+            launched = []  # (sub, tag, ok_device, B)
+            n_launched = 0
+            with _maybe_profile():
+                for idxs, plan in grouped:
+                    sub = [fits[j] for j in idxs]
+                    batch = pack_batch([encs[i] for i in sub])
+                    # Bucketing trades padding work for jit-cache
+                    # stability. For a FEW LONG histories the trade
+                    # inverts: padding a 2-history 16k-event group to 8
+                    # rows quadruples its kernel time, while the compile
+                    # cache only ever sees a handful of such launches —
+                    # use exact shapes there.
+                    e_len = batch["events"].shape[1]
+                    exact = (e_len > MERGE_MAX_EVENTS and len(sub) <= 16)
+                    ev, (val_of,), B = pad_batch_bucketed(
+                        batch["events"], (plan.val_of,),
+                        floor_b=len(sub) if exact else 8,
+                        floor_e=None if exact else 32)
+                    tag = plan.kernel_tag
+                    if want_pallas and plan.kind == "domain":
+                        # Pallas path (ops/pallas_scan.py): same search,
+                        # frontier pinned in VMEM. Interpret off-TPU.
+                        import jax
 
-                    from ..ops.pallas_scan import make_pallas_batch_checker
-                    kernel = make_pallas_batch_checker(
-                        model, plan.n_slots, plan.n_states, ev.shape[1],
-                        interpret=jax.default_backend() != "tpu")
-                    tag = "pallas"
-                else:
-                    kernel = make_dense_batch_checker(
-                        model, plan.kind, plan.n_slots, plan.n_states)
-                t0 = time.perf_counter()
-                with _maybe_profile():
+                        from ..ops.pallas_scan import (
+                            make_pallas_batch_checker)
+                        kernel = make_pallas_batch_checker(
+                            model, plan.n_slots, plan.n_states,
+                            ev.shape[1],
+                            interpret=jax.default_backend() != "tpu")
+                        tag = "pallas"
+                    else:
+                        kernel = make_dense_batch_checker(
+                            model, plan.kind, plan.n_slots, plan.n_states)
+                        if _route_group_to_host(ev.shape[0], ev.shape[1]):
+                            # Tiny batch + tunneled chip: the host mesh
+                            # wins (see PLATFORM_ROUTE_MIN_CELLS).
+                            # Committed inputs carry the computation to
+                            # the CPU backend; the jit cache keys on
+                            # sharding, so both placements coexist.
+                            import jax
+
+                            host = jax.devices("cpu")[0]
+                            ev = jax.device_put(ev, host)
+                            val_of = jax.device_put(val_of, host)
+                            tag += "@host"
                     ok, _ = kernel(ev, val_of)
-                ok = np.asarray(ok)[:B]
-                dt = time.perf_counter() - t0
-                for j, i in enumerate(sub):
-                    results[i] = _jx(VALID if ok[j] else INVALID, encs[i],
-                                     dt / len(sub), kernel=tag)
+                    launched.append((sub, tag, ok, B))
+                    n_launched += len(sub)
+                t_prev = t0
+                for g, (sub, tag, ok, B) in enumerate(launched):
+                    ok = np.asarray(ok)[:B]  # blocks: device → host
+                    t_now = time.perf_counter()
+                    # Per-history time under pipelining: the MARGINAL
+                    # wall this group added (delta between successive
+                    # blocking reads; the first group also absorbs the
+                    # shared pack+launch span). Groups overlap on
+                    # device, so exact per-group kernel attribution
+                    # does not exist — this keeps sums meaningful.
+                    dt = t_now - t_prev
+                    t_prev = t_now
+                    for j, i in enumerate(sub):
+                        results[i] = _jx(VALID if ok[j] else INVALID,
+                                         encs[i], dt / max(len(sub), 1),
+                                         kernel=tag)
         # Histories beyond the dense caps continue to the sort ladder.
         fits = [fits[j] for j in rest]
     if fits:

@@ -156,13 +156,21 @@ def _run_once(model, events: np.ndarray, mesh: Mesh, n_configs: int,
 def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
                         n_configs: Optional[int] = None,
                         n_slots: int = MAX_SLOTS,
-                        dense: Optional[tuple] = None):
+                        dense: Optional[tuple] = None,
+                        defer: bool = False):
     """Check a packed event batch across the mesh.
 
     events: [B, E, 5] int32 (history/packing.py layout). Pads B up to a
     multiple of the mesh size with EV_PAD histories (trivially valid, no
     FORCE events → sliced off afterwards). Returns (ok[B], overflow[B],
     n_valid, n_unknown) host values corrected for padding.
+
+    `defer=True` returns a zero-arg finalizer instead: the dense-plan
+    launch is dispatched asynchronously and the finalizer blocks for the
+    host values — callers with several window groups launch them all and
+    block once, so a tunneled chip pipelines the groups instead of paying
+    a round trip per group (the capacity ladder must block per rung to
+    decide escalation, so its finalizer is pre-resolved).
 
     `dense` — a `ops.dense_scan.DensePlan` — routes the batch to the
     dense-bitset kernel (domain or mask mode): exact, ladder-free, ~10×+
@@ -189,8 +197,12 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
         ok, overflow, n_valid, _ = fn(jax.device_put(events, sharding),
                                       jax.device_put(val_of, vsharding),
                                       jax.device_put(mask, msharding))
-        ok = np.asarray(ok)[:B]
-        return ok, np.zeros((B,), bool), int(n_valid), 0
+
+        def finalize(ok=ok, n_valid=n_valid, B=B):
+            return (np.asarray(ok)[:B], np.zeros((B,), bool),
+                    int(n_valid), 0)
+
+        return finalize if defer else finalize()
     ladder = ([n_configs] if n_configs else
               [64, DEFAULT_N_CONFIGS] if DEFAULT_N_CONFIGS > 64
               else [DEFAULT_N_CONFIGS])
@@ -211,4 +223,5 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
     # linearization is real. Only overflowed-and-not-ok is undecided.
     n_valid = int(np.sum(ok))
     n_unknown = int(np.sum(overflow & ~ok))
-    return ok, overflow, n_valid, n_unknown
+    out = (ok, overflow, n_valid, n_unknown)
+    return (lambda: out) if defer else out
