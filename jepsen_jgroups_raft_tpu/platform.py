@@ -38,3 +38,36 @@ def pin_cpu(n_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def is_backend_init_failure(e: BaseException) -> bool:
+    """True for the failure flavors of an unusable accelerator backend:
+    init refusal (plugin unregistered / unknown platform) and the
+    tunnel-drop modes (UNAVAILABLE, DEADLINE_EXCEEDED, setup/compile
+    errors). Shared by bench.py's CPU re-exec and the checker's in-
+    process degrade so the two paths recognize the same world."""
+    text = f"{type(e).__name__}: {e}"
+    return ("Unable to initialize backend" in text
+            or "backend setup/compile error" in text
+            or "UNAVAILABLE" in text
+            or "DEADLINE_EXCEEDED" in text)
+
+
+def cpu_subprocess_env(base: dict | None = None) -> dict:
+    """Environment for a CPU-only child interpreter, with the TPU-tunnel
+    plugin registration DISARMED.
+
+    `pin_cpu` protects the current process, but a child interpreter runs
+    sitecustomize before any of our code, and with PALLAS_AXON_POOL_IPS
+    set the axon `register()` call there contacts the tunnel relay — a
+    wedged relay (observed 2026-07-30: 100% of interpreter starts hung
+    >30 s) blocks the child BEFORE it can pin anything. Stripping the
+    pool-IPs var makes sitecustomize skip registration entirely, so the
+    child starts instantly and cannot reach the TPU — exactly right for
+    CPU-bound children (soak workers, sanitizer runs, the bench's CPU
+    re-exec). Children that WANT the TPU must keep the env and guard
+    with a subprocess timeout instead."""
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

@@ -27,7 +27,11 @@ def test_two_process_global_mesh_psum():
     port = free_port()
     procs = []
     for pid in range(2):
-        env = dict(os.environ)
+        from jepsen_jgroups_raft_tpu.platform import cpu_subprocess_env
+
+        # Disarmed-tunnel env: a wedged relay otherwise hangs the worker
+        # interpreter inside sitecustomize's axon registration.
+        env = cpu_subprocess_env()
         # The worker pins its own platform/device count (pin_cpu(4));
         # an inherited XLA_FLAGS device count would override it (pin_cpu
         # only ever raises the count), so drop it.

@@ -12,15 +12,20 @@ from pathlib import Path
 
 import pytest
 
+from jepsen_jgroups_raft_tpu.platform import cpu_subprocess_env
+
 pytestmark = [pytest.mark.slow, pytest.mark.soak]
 
 REPO = Path(__file__).resolve().parents[1]
 
 
 def _run(script, *args):
+    # Disarmed-tunnel env: a wedged relay otherwise hangs the child
+    # interpreter inside sitecustomize before the script even starts.
     return subprocess.run(
         [sys.executable, str(REPO / "scripts" / script), *args],
-        capture_output=True, text=True, timeout=420, cwd=REPO)
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=cpu_subprocess_env())
 
 
 def test_soak_differential_smoke():

@@ -80,11 +80,26 @@ def test_ssh_tier_full_lifecycle_executes(tmp_path, shimmed_path):
     test = {"nodes": [node], "members": {node},
             "store_dir": str(tmp_path / "store")}
     os.makedirs(test["store_dir"])
+    def await_leader(timeout=10.0):
+        # db.setup awaits the client PORT (the reference's own readiness
+        # bar, server.clj:158-161); leadership lands a beat later and a
+        # bare put would faithfully raise NotLeader (definite :fail in
+        # the error taxonomy — live workloads just retry the next op).
+        # This test asserts on the FIRST op, so wait out the election.
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = cluster.probe(node, timeout=1.0)
+            if v is not None and v[0]:
+                return
+            time.sleep(0.05)
+        raise TimeoutError("no leader elected")
+
     try:
         # install + daemonize + await (db/DB setup!)
         assert db.setup(test, node) is None
         assert (tmp_path / "opt-raft" / "server.pid").exists()
         assert cluster.start_node(node, [node]) == "already-running"
+        await_leader()
 
         conn = NativeRsmConn(*cluster.resolve(node), timeout=3.0)
         try:
