@@ -556,3 +556,23 @@ def test_unavailable_pinned_backend_degrades_to_host():
                              os.path.abspath(__file__))))
     assert out.returncode == 0, out.stderr[-2000:]
     assert "DEGRADED_OK" in out.stdout
+
+
+def test_calibrate_routing_script_runs():
+    """The routing-gate calibration script (doc/running.md "Measured
+    routing gates") must stay runnable — on a CPU-only session it
+    reports the degenerate single-backend case and exits 0."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from jepsen_jgroups_raft_tpu.platform import cpu_subprocess_env
+
+    repo = Path(__file__).resolve().parents[1]
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "calibrate_routing.py"),
+         "--quick", "--repeats", "1"],
+        capture_output=True, text=True, timeout=360,
+        env=cpu_subprocess_env(), cwd=repo)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "cells" in out.stdout
