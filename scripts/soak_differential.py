@@ -103,11 +103,48 @@ def main(argv=None) -> int:
     def oracle_verdict(enc, model, seed):
         """Unpruned-unbounded frontier; None when genuinely infeasible
         (astronomically wide window — the generator's max_crashes cap
-        makes this rare at soak shapes)."""
+        makes this rare at soak shapes). Valid verdicts also have their
+        WITNESS replayed through the sequential model — a witness that
+        does not replay legally (or linearizes fewer ops than the
+        history forces) is a soundness bug in the witness machinery
+        even when the verdict itself is right."""
         try:
-            return check_encoded_cpu(enc, model).valid
+            r = check_encoded_cpu(enc, model, witness=True)
         except FrontierOverflow:
             return None
+        if r.valid:
+            from jepsen_jgroups_raft_tpu.history.packing import (EV_FORCE,
+                                                                 EV_OPEN)
+
+            fab = {}
+            n_force = 0
+            for row, oi in zip(enc.events, enc.op_index):
+                if row[0] == EV_OPEN:
+                    fab[int(oi)] = (int(row[2]), int(row[3]), int(row[4]))
+                elif row[0] == EV_FORCE:
+                    n_force += 1
+            state = model.init_state()
+            for oi in r.witness:
+                if oi not in fab:
+                    # An op index with no OPEN row is itself the
+                    # witness-machinery breakage this check hunts —
+                    # record it, don't crash the campaign on KeyError.
+                    mismatches.append({
+                        "seed": seed, "kind": "witness-unknown-op",
+                        "witness": r.witness, "at": oi})
+                    break
+                f, a, b = fab[oi]
+                state, legal = model.step(state, f, a, b)
+                if not legal:
+                    mismatches.append({
+                        "seed": seed, "kind": "witness-replay-illegal",
+                        "witness": r.witness, "at": oi})
+                    break
+            if len(r.witness) < n_force:
+                mismatches.append({
+                    "seed": seed, "kind": "witness-too-short",
+                    "witness_len": len(r.witness), "n_force": n_force})
+        return r.valid
 
     def dfs_verdict(enc, model):
         try:

@@ -59,20 +59,26 @@ def parse_args(argv=None):
                    help="log-compaction threshold (0 disables; default 24 "
                         "keeps snapshot/InstallSnapshot paths under fire)")
     p.add_argument("--nemesis", default="hell")
+    p.add_argument("--nodes", type=int, default=5,
+                   help="cluster size (default 5); --vary-nodes overrides")
+    p.add_argument("--vary-nodes", action="store_true",
+                   help="cycle cluster sizes 3/5/7 across runs for "
+                        "fault-space diversity (membership churn against "
+                        "different majority thresholds)")
     p.add_argument("--keep-stores", action="store_true",
                    help="keep every run's store dir (default: only "
                         "failures are kept)")
     return p.parse_args(argv)
 
 
-def one_run(i: int, args, workload: str, workdir: Path) -> dict:
+def one_run(i: int, args, workload: str, n: int, workdir: Path) -> dict:
     from jepsen_jgroups_raft_tpu.core.compose import compose_test
     from jepsen_jgroups_raft_tpu.core.runner import run_test
     from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
                                                       LocalRaftDB)
 
     seed = args.seed + i
-    nodes = ["n1", "n2", "n3", "n4", "n5"]
+    nodes = [f"n{k}" for k in range(1, n + 1)]
     cluster = LocalCluster(nodes, sm=WORKLOAD_SM[workload],
                            workdir=str(workdir / "sut"),
                            election_ms=150, heartbeat_ms=50,
@@ -87,6 +93,11 @@ def one_run(i: int, args, workload: str, workdir: Path) -> dict:
         "operation_timeout": 2.0, "concurrency": args.concurrency,
         "store_root": str(workdir / "store"),
     }
+    if workload == "election":
+        # Wire the every-node views probe so election runs soak the
+        # opt-in cross-node majority model, not just inspect parity
+        # (same wiring as the CLI, cli.py election branch).
+        opts["views_probe"] = cluster.views_probe
     test = compose_test(opts, db=LocalRaftDB(cluster, seed=seed),
                         net=BlockNet(cluster), seed=seed)
     try:
@@ -97,6 +108,7 @@ def one_run(i: int, args, workload: str, workdir: Path) -> dict:
     wl = res.get("workload", {})
     return {
         "seed": seed,
+        "nodes": n,
         "workload": workload,
         "valid": wl.get("valid?"),
         "ok_ops": sum(1 for op in test["history"] if op.type == "ok"),
@@ -118,11 +130,17 @@ def main(argv=None) -> int:
     failures, unknowns = [], []
     for i in range(args.runs):
         workload = workloads[i % len(workloads)]
+        # Size cycle advances once per FULL workload cycle so every
+        # workload×size combination is reached (a lockstep i%3 cycle
+        # would pin each workload to one fixed size — round-4 reviewer
+        # finding).
+        n = ((3, 5, 7)[(i // len(workloads)) % 3] if args.vary_nodes
+             else args.nodes)
         workdir = Path(tempfile.mkdtemp(prefix=f"soak-hell-{i}-"))
         try:
-            r = one_run(i, args, workload, workdir)
+            r = one_run(i, args, workload, n, workdir)
         except Exception as e:  # noqa: BLE001 — a wedged run is a finding
-            r = {"seed": args.seed + i, "workload": workload,
+            r = {"seed": args.seed + i, "workload": workload, "nodes": n,
                  "valid": None, "error": f"{type(e).__name__}: {e}",
                  "store_dir": str(workdir)}
         keep = args.keep_stores or r["valid"] is not True
