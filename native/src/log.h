@@ -331,17 +331,43 @@ class RaftLog {
     while (off + 4 <= all.size()) {
       Reader hdr(all.data() + off, 4);
       uint32_t len = hdr.u32();
-      if (off + 4 + len > all.size()) break;  // torn tail record: drop
+      // Torn-tail forms: a length promising more bytes than the file
+      // holds, or one below the minimum encoded record (u64 term +
+      // u8 type = 9) — the OS-crash zero-fill case decodes len=0 and
+      // previously slipped through as a "complete" record whose body
+      // decode then aborted the node on EVERY restart (round-4
+      // review finding). Trailing-prefix drop is sound because fsync
+      // ordering makes any acked record fully on disk: a torn record
+      // is by construction the final, unacked one.
+      if (len < 9 || off + 4 + len > all.size()) break;
       ++idx;
       if (idx > base_index_) {
-        Reader r(all.data() + off + 4, len);
         LogEntry e;
-        e.term = r.u64();
-        e.type = r.u8();
-        e.data = r.rest();
+        try {
+          Reader r(all.data() + off + 4, len);
+          e.term = r.u64();
+          e.type = r.u8();
+          e.data = r.rest();
+        } catch (const WireError&) {  // belt-and-braces: treat as torn
+          break;
+        }
         entries_.push_back(std::move(e));
       }
       off += 4 + len;
+    }
+    if (off < all.size()) {
+      // Torn tail (OS crash mid-append): the garbage bytes were never
+      // acked, so dropping them is correct — but they must also leave
+      // the FILE, because persist_append APPENDS: a later record
+      // written after surviving garbage would be unreachable to the
+      // next load, silently losing entries this node has acked by then
+      // (round-4 selftest finding — the double-crash scenario).
+      if (::truncate(log_path().c_str(), static_cast<off_t>(off)) != 0)
+        die("log torn-tail truncate failed");
+      int f = ::open(log_path().c_str(), O_WRONLY);
+      if (f < 0) die("log open for torn-tail fsync failed");
+      if (::fsync(f) != 0) die("log torn-tail fsync failed");
+      ::close(f);
     }
   }
 };

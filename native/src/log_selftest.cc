@@ -80,6 +80,27 @@ int main(int argc, char** argv) {
   //    (the rewrite's header pins base_index+1 as the first record).
   if (argc > 1) {
     std::string dir = argv[1];
+    if (argc > 2 && std::string(argv[2]) == "failstop") {
+      // A log whose header proves compaction happened but whose
+      // snapshot is missing must FAIL-STOP (loading the tail at
+      // shifted indices onto empty state would silently diverge).
+      // Expected outcome: die() → abort, so the harness asserts a
+      // non-zero exit on THIS invocation.
+      std::string d = dir + "/failstop";
+      ::mkdir(dir.c_str(), 0755);
+      ::mkdir(d.c_str(), 0755);
+      std::ofstream lf(d + "/log", std::ios::binary);
+      raftnative::Buf hdr;  // wire-endian, like the real writer
+      hdr.u32(0xFFFFFFFFu);
+      hdr.u64(10);
+      lf.write(hdr.s.data(), static_cast<std::streamsize>(hdr.s.size()));
+      lf.close();
+      RaftLog log;
+      log.open(dir, "failstop");  // must abort
+      std::fprintf(stderr, "FAIL: compacted log without snapshot "
+                           "loaded instead of fail-stopping\n");
+      return 1;
+    }
     {
       RaftLog log;
       log.open(dir, "selftest");
@@ -89,6 +110,113 @@ int main(int argc, char** argv) {
     {
       RaftLog log;
       log.open(dir, "selftest");
+      CHECK(log.base_index() == 3 && log.base_term() == 2);
+      CHECK(log.last_index() == 5);
+      CHECK(log.at(4).data == "d" && log.at(5).data == "e");
+      CHECK(log.snapshot_state() == "S3");
+    }
+    // 6. Torn tail record (OS crash mid-append, past the fsync'd
+    //    prefix): a trailing record whose length field promises more
+    //    bytes than the file holds is dropped; the intact prefix and
+    //    subsequent appends survive.
+    {
+      std::string d = dir + "/torn-tail";
+      { RaftLog log; log.open(dir, "torn-tail"); fill(log); }
+      std::ofstream f(d + "/log", std::ios::binary | std::ios::app);
+      raftnative::Buf torn;  // wire-endian: promises 100 bytes, has 6
+      torn.u32(100);
+      torn.raw("abcdef");
+      f.write(torn.s.data(), static_cast<std::streamsize>(torn.s.size()));
+      f.close();
+      {
+        RaftLog log;
+        log.open(dir, "torn-tail");
+        CHECK(log.last_index() == 5);
+        CHECK(log.at(5).data == "e");
+        log.append(entry(4, "f"));
+        CHECK(log.last_index() == 6);
+      }
+      // Double-crash: the append after torn-tail recovery must be
+      // durable — recovery truncates the garbage so the new record
+      // is reachable on the NEXT load too (an append landing after
+      // surviving garbage would be silently lost).
+      RaftLog log;
+      log.open(dir, "torn-tail");
+      CHECK(log.last_index() == 6);
+      CHECK(log.at(6).data == "f");
+    }
+    // 6b. OS-crash zero-fill tail: file extended with zeroed blocks
+    //     (len decodes 0). Must be dropped+truncated like any torn
+    //     tail — this form used to parse as a zero-length record and
+    //     abort the node on every restart.
+    {
+      std::string d = dir + "/zero-tail";
+      { RaftLog log; log.open(dir, "zero-tail"); fill(log); }
+      {
+        std::ofstream f(d + "/log", std::ios::binary | std::ios::app);
+        const char zeros[16] = {0};
+        f.write(zeros, sizeof zeros);
+      }
+      {
+        RaftLog log;
+        log.open(dir, "zero-tail");
+        CHECK(log.last_index() == 5);
+        log.append(entry(4, "z"));
+      }
+      RaftLog log;
+      log.open(dir, "zero-tail");
+      CHECK(log.last_index() == 6);
+      CHECK(log.at(6).data == "z");
+    }
+    // 7. File truncated mid-record (torn write of the LAST record):
+    //    the complete prefix is recovered.
+    {
+      std::string d = dir + "/torn-mid";
+      { RaftLog log; log.open(dir, "torn-mid"); fill(log); }
+      struct stat st;
+      CHECK(::stat((d + "/log").c_str(), &st) == 0);
+      CHECK(::truncate((d + "/log").c_str(),
+                       static_cast<off_t>(st.st_size - 3)) == 0);
+      RaftLog log;
+      log.open(dir, "torn-mid");
+      CHECK(log.last_index() == 4);
+      CHECK(log.at(4).data == "d");
+    }
+    // 8. Corrupt/truncated snapshot with a full-coverage log: recovery
+    //    falls back to the log alone (snap never atomically landed).
+    {
+      std::string d = dir + "/torn-snap";
+      { RaftLog log; log.open(dir, "torn-snap"); fill(log); }
+      std::ofstream f(d + "/snap", std::ios::binary);
+      f.write("xx", 2);  // torn: not even a full base_index u64
+      f.close();
+      RaftLog log;
+      log.open(dir, "torn-snap");
+      CHECK(log.base_index() == 0);
+      CHECK(log.last_index() == 5);
+      CHECK(log.at(1).data == "a" && log.at(5).data == "e");
+    }
+    // 9. Crash BETWEEN snapshot-rename and log-rewrite-rename: old
+    //    (headerless, full) log next to the new snapshot — the stale
+    //    prefix below the snapshot base is skipped on load.
+    {
+      std::string d = dir + "/stale-prefix";
+      { RaftLog log; log.open(dir, "stale-prefix"); fill(log); }
+      std::ifstream in(d + "/log", std::ios::binary);
+      std::string old_log((std::istreambuf_iterator<char>(in)),
+                          std::istreambuf_iterator<char>());
+      in.close();
+      {
+        RaftLog log;
+        log.open(dir, "stale-prefix");
+        log.compact(3, "S3", "cfg");
+      }
+      std::ofstream out(d + "/log", std::ios::binary | std::ios::trunc);
+      out.write(old_log.data(),
+                static_cast<std::streamsize>(old_log.size()));
+      out.close();
+      RaftLog log;
+      log.open(dir, "stale-prefix");
       CHECK(log.base_index() == 3 && log.base_term() == 2);
       CHECK(log.last_index() == 5);
       CHECK(log.at(4).data == "d" && log.at(5).data == "e");

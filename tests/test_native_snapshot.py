@@ -302,7 +302,11 @@ def test_log_selftest_install_snapshot_retention(tmp_path):
     matching last-included (index, term) — Raft Fig. 13 rule 6 — and
     discards on mismatch/coverage; the retained suffix survives reopen.
     (Round-3 advisor finding: wholesale discard leaned on the transport
-    being per-peer FIFO loss-only.)"""
+    being per-peer FIFO loss-only.) Also covers torn-write crash
+    recovery: torn tail records (incl. the double-crash append-after-
+    recovery durability case), mid-record truncation, corrupt snapshot
+    fallback, and the stale-prefix skip after a crash between
+    snapshot-rename and log-rewrite."""
     import subprocess
 
     from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
@@ -313,3 +317,21 @@ def test_log_selftest_install_snapshot_retention(tmp_path):
         capture_output=True, text=True, timeout=30)
     assert out.returncode == 0, out.stderr
     assert "LOG_SELFTEST_PASS" in out.stdout
+
+
+def test_log_selftest_failstop_on_lost_snapshot(tmp_path):
+    """A log whose header proves compaction happened, next to a missing
+    snapshot, must FAIL-STOP on load (loading the tail at shifted
+    indices onto empty state would silently diverge) — the same stance
+    as persistence failure."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"),
+         "failstop"],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode != 0
+    assert "snap file lost/corrupt" in out.stderr
