@@ -222,11 +222,39 @@ class RaftLog {
     }
   }
 
+  // CRC-32 (IEEE, reflected) over a byte range — the per-record
+  // integrity check that lets recovery DISTINGUISH a crash-torn tail
+  // (droppable: fsync ordering proves it unacked) from rot of synced,
+  // acked bytes (fail-stop), and catches body rot that would otherwise
+  // decode cleanly and feed garbage to the state machine.
+  static uint32_t crc32(const char* p, size_t n) {
+    static const uint32_t* table = [] {
+      static uint32_t t[256];
+      for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+          c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        t[i] = c;
+      }
+      return t;
+    }();
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; ++i)
+      c = table[(c ^ static_cast<unsigned char>(p[i])) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+  }
+
+  // Record framing: u32 len | u64 term | u8 type | data | u32 crc,
+  // where len covers term..crc and crc covers term..data. Minimum
+  // encoded record = 8 + 1 + 4 = 13 bytes.
+  static constexpr uint32_t kMinRecordLen = 13;
+
   static Bytes encode_entry(const LogEntry& e) {
     Buf rec;
     rec.u64(e.term);
     rec.u8(e.type);
     rec.raw(e.data);
+    rec.u32(crc32(rec.s.data(), rec.s.size()));
     Buf framed;
     framed.u32(static_cast<uint32_t>(rec.s.size()));
     framed.raw(rec.s);
@@ -331,26 +359,61 @@ class RaftLog {
     while (off + 4 <= all.size()) {
       Reader hdr(all.data() + off, 4);
       uint32_t len = hdr.u32();
-      // Torn-tail forms: a length promising more bytes than the file
-      // holds, or one below the minimum encoded record (u64 term +
-      // u8 type = 9) — the OS-crash zero-fill case decodes len=0 and
-      // previously slipped through as a "complete" record whose body
-      // decode then aborted the node on EVERY restart (round-4
-      // review finding). Trailing-prefix drop is sound because fsync
-      // ordering makes any acked record fully on disk: a torn record
-      // is by construction the final, unacked one.
-      if (len < 9 || off + 4 + len > all.size()) break;
+      // Recovery discriminator (round-4 review iterations). Trailing-
+      // prefix DROP is sound only for what a crash mid-append leaves —
+      // fsync ordering proves any ACKED record fully on disk, so a
+      // torn record is by construction the final, unacked one. Rot of
+      // synced bytes (dying disk) is a persistence anomaly on acked
+      // data and must FAIL-STOP (same stance as write-time failure):
+      //   * length promising more bytes than the file holds →
+      //     incomplete append: drop. (Residual ambiguity: a length
+      //     field rotted to a huge value looks identical; the
+      //     per-record CRC below cannot check an incomplete record.
+      //     This is the one rot shape still read as a torn tail.)
+      //   * sub-minimum length over ALL-ZERO remainder → OS-crash
+      //     zero-fill: drop.
+      //   * sub-minimum length amid non-zero bytes → rotted length
+      //     field: die.
+      //   * complete record whose CRC mismatches → torn only when it
+      //     is the FINAL record (partial flush of the last append);
+      //     mid-file it is body/term rot — decoding it would feed
+      //     garbage to the state machine: die.
+      if (off + 4 + len > all.size()) break;
+      if (len < kMinRecordLen) {
+        for (size_t i = off; i < all.size(); ++i)
+          if (all[i] != 0) {
+            errno = EIO;
+            die("log record corrupt mid-file (acked data rotted)");
+          }
+        break;  // zero-fill torn tail
+      }
+      Reader body(all.data() + off + 4, len);
+      uint64_t term = body.u64();
+      uint8_t type = body.u8();
+      Bytes data(all.data() + off + 4 + 9, len - kMinRecordLen);
+      Reader tail(all.data() + off + len, 4);  // last 4 bytes of record
+      if (tail.u32() != crc32(all.data() + off + 4, len - 4)) {
+        // Droppable torn FINAL append: nothing but (optional zero-fill)
+        // after it — a torn body and a zero-extended file are artifacts
+        // of the same unacked crash (review repro: both at once used to
+        // take the mid-file branch and wedge the node). Any NON-zero
+        // byte after a CRC-bad record means acked data follows rot: die.
+        bool tail_only = true;
+        for (size_t i = off + 4 + len; i < all.size(); ++i)
+          if (all[i] != 0) {
+            tail_only = false;
+            break;
+          }
+        if (tail_only) break;  // torn final append (+ zero-fill)
+        errno = EIO;
+        die("log record crc mismatch mid-file (acked data rotted)");
+      }
       ++idx;
       if (idx > base_index_) {
         LogEntry e;
-        try {
-          Reader r(all.data() + off + 4, len);
-          e.term = r.u64();
-          e.type = r.u8();
-          e.data = r.rest();
-        } catch (const WireError&) {  // belt-and-braces: treat as torn
-          break;
-        }
+        e.term = term;
+        e.type = type;
+        e.data = std::move(data);
         entries_.push_back(std::move(e));
       }
       off += 4 + len;

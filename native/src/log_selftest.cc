@@ -6,6 +6,7 @@
 // contract: the reference SUT's FileBasedLog + jgroups-raft snapshot
 // install (SURVEY.md §5.4); the retention rule is the round-3 advisor fix.
 #include <cstdio>
+#include <fstream>
 #include <cstdlib>
 #include <string>
 
@@ -80,6 +81,43 @@ int main(int argc, char** argv) {
   //    (the rewrite's header pins base_index+1 as the first record).
   if (argc > 1) {
     std::string dir = argv[1];
+    if (argc > 2 && std::string(argv[2]) == "rotten") {
+      // Mid-file rot: a synced record's length field corrupted to a
+      // sub-minimum value amid non-zero bytes. Neither torn-tail form
+      // applies — truncating would durably destroy the acked suffix —
+      // so recovery must FAIL-STOP (abort expected by the harness).
+      std::string d = dir + "/rotten";
+      { RaftLog log; log.open(dir, "rotten"); fill(log); }
+      std::fstream f(d + "/log",
+                     std::ios::binary | std::ios::in | std::ios::out);
+      raftnative::Buf bad;
+      bad.u32(3);  // sub-minimum length over record #1's intact header
+      f.seekp(0);
+      f.write(bad.s.data(), static_cast<std::streamsize>(bad.s.size()));
+      f.close();
+      RaftLog log;
+      log.open(dir, "rotten");  // must abort
+      std::fprintf(stderr, "FAIL: mid-file rot truncated acked data "
+                           "instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "rotten-body") {
+      // Mid-file BODY rot with an intact length: without the per-record
+      // CRC this decoded cleanly and fed garbage to the state machine;
+      // now it must FAIL-STOP (abort expected by the harness).
+      std::string d = dir + "/rotten-body";
+      { RaftLog log; log.open(dir, "rotten-body"); fill(log); }
+      std::fstream f(d + "/log",
+                     std::ios::binary | std::ios::in | std::ios::out);
+      f.seekp(4 + 8);  // first record's type/data region
+      f.write("X", 1);
+      f.close();
+      RaftLog log;
+      log.open(dir, "rotten-body");  // must abort
+      std::fprintf(stderr, "FAIL: mid-file body rot decoded instead of "
+                           "fail-stopping\n");
+      return 1;
+    }
     if (argc > 2 && std::string(argv[2]) == "failstop") {
       // A log whose header proves compaction happened but whose
       // snapshot is missing must FAIL-STOP (loading the tail at
@@ -167,6 +205,63 @@ int main(int argc, char** argv) {
       log.open(dir, "zero-tail");
       CHECK(log.last_index() == 6);
       CHECK(log.at(6).data == "z");
+    }
+    // 6c. CRC mismatch on the FINAL record (partial flush of the last
+    //     append: full length landed, bytes torn): dropped like any
+    //     torn tail, durable, and the intact prefix survives.
+    {
+      std::string d = dir + "/torn-crc";
+      { RaftLog log; log.open(dir, "torn-crc"); fill(log); }
+      {
+        struct stat st;
+        CHECK(::stat((d + "/log").c_str(), &st) == 0);
+        std::fstream f(d + "/log",
+                       std::ios::binary | std::ios::in | std::ios::out);
+        f.seekp(st.st_size - 6);  // inside the LAST record's body/crc
+        f.write("??", 2);
+        f.close();
+      }
+      {
+        RaftLog log;
+        log.open(dir, "torn-crc");
+        CHECK(log.last_index() == 4);
+        CHECK(log.at(4).data == "d");
+        log.append(entry(4, "g"));
+      }
+      RaftLog log;
+      log.open(dir, "torn-crc");
+      CHECK(log.last_index() == 5);
+      CHECK(log.at(5).data == "g");
+    }
+    // 6d. Composite crash artifact: torn FINAL record body + zero-fill
+    //     file extension (one unacked crash can produce both). Still a
+    //     droppable torn tail — this combination used to take the
+    //     mid-file-rot branch and wedge the node (review repro).
+    {
+      std::string d = dir + "/torn-crc-zero";
+      { RaftLog log; log.open(dir, "torn-crc-zero"); fill(log); }
+      {
+        struct stat st;
+        CHECK(::stat((d + "/log").c_str(), &st) == 0);
+        std::fstream f(d + "/log",
+                       std::ios::binary | std::ios::in | std::ios::out);
+        f.seekp(st.st_size - 6);
+        f.write("??", 2);
+        f.close();
+        std::ofstream a(d + "/log", std::ios::binary | std::ios::app);
+        const char zeros[8] = {0};
+        a.write(zeros, sizeof zeros);
+      }
+      {
+        RaftLog log;
+        log.open(dir, "torn-crc-zero");
+        CHECK(log.last_index() == 4);
+        log.append(entry(4, "h"));
+      }
+      RaftLog log;
+      log.open(dir, "torn-crc-zero");
+      CHECK(log.last_index() == 5);
+      CHECK(log.at(5).data == "h");
     }
     // 7. File truncated mid-record (torn write of the LAST record):
     //    the complete prefix is recovered.

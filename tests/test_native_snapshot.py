@@ -335,3 +335,39 @@ def test_log_selftest_failstop_on_lost_snapshot(tmp_path):
         capture_output=True, text=True, timeout=30)
     assert out.returncode != 0
     assert "snap file lost/corrupt" in out.stderr
+
+
+def test_log_selftest_failstop_on_midfile_rot(tmp_path):
+    """A synced record's length field rotted to a sub-minimum value amid
+    non-zero bytes is a persistence anomaly on ACKED data — recovery
+    must fail-stop, not durably truncate the acked suffix behind it
+    (round-4 review finding; zero-fill and incomplete-append torn tails
+    are the droppable forms)."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"),
+         "rotten"],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode != 0
+    assert "corrupt mid-file" in out.stderr
+
+
+def test_log_selftest_failstop_on_body_rot(tmp_path):
+    """Per-record CRC: mid-file BODY rot with an intact length used to
+    decode cleanly and feed garbage to the state machine — it must
+    fail-stop."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"),
+         "rotten-body"],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode != 0
+    assert "crc mismatch mid-file" in out.stderr
