@@ -12,11 +12,14 @@
 //   meta    — current_term u64 | voted_for str   (atomic tmp+rename rewrite)
 //   snap    — base_index u64 | base_term u64 | sm_state str | config str
 //             (atomic tmp+rename; covers log prefix 1..base_index)
-//   log     — optional header (u32 0xFFFFFFFF | u64 start_index) then
-//             append-only records: u32 len | u64 term | u8 type | data.
-//             The header pins which absolute index the first record holds,
-//             so a crash between snap-write and log-rewrite is recoverable
-//             (stale prefix records below the snapshot base are skipped).
+//   log     — v2 header (u32 0xFFFFFFFE | u64 start_index) then
+//             append-only records: u32 len | u64 term | u8 type | data |
+//             u32 crc (crc over term..data). The header pins which
+//             absolute index the first record holds (so a crash between
+//             snap-write and log-rewrite is recoverable — stale prefix
+//             records below the snapshot base are skipped) and versions
+//             the record framing; a file without a complete v2 header
+//             provably holds no acked data and is dropped whole.
 // Conflict truncation rewrites the log file (rare; fine at harness scale).
 // Indexing is 1-based like the Raft paper; index 0 = empty-log sentinel;
 // with a snapshot, indices 1..base_index live only in the snapshot.
@@ -144,7 +147,9 @@ class RaftLog {
   }
 
  private:
-  static constexpr uint32_t kLogHeaderMagic = 0xFFFFFFFFu;
+  // 0xFFFFFFFF was the round-3 headerless/no-CRC era's magic; v2 is the
+  // only format recovery accepts (no log outlives its cluster here).
+  static constexpr uint32_t kLogHeaderMagicV2 = 0xFFFFFFFEu;
 
   std::vector<LogEntry> entries_;
   uint64_t current_term_ = 0;
@@ -263,9 +268,22 @@ class RaftLog {
 
   void persist_append(const LogEntry& e) {
     if (dir_.empty()) return;
-    bool fresh = ::access(log_path().c_str(), F_OK) != 0;
+    // "Fresh" = needs the v2 header: missing OR empty (recovery may
+    // have truncated a torn first write to zero bytes; existence alone
+    // would then produce a headerless file the next load rejects).
+    struct stat st;
+    bool fresh = ::stat(log_path().c_str(), &st) != 0 || st.st_size == 0;
     int f = ::open(log_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
     if (f < 0) die("log open failed");
+    if (fresh) {
+      // Every file starts with the v2 header: it both pins the first
+      // record's absolute index and VERSIONS the record format (CRC
+      // suffix), so recovery never guesses which framing a file uses.
+      Buf hdr;
+      hdr.u32(kLogHeaderMagicV2);
+      hdr.u64(base_index_ + 1);
+      write_all(f, hdr.s);
+    }
     write_all(f, encode_entry(e));
     if (::fsync(f) != 0) die("log fsync failed");
     ::close(f);
@@ -277,12 +295,10 @@ class RaftLog {
     std::string tmp = log_path() + ".tmp";
     int f = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
     if (f < 0) die("log rewrite open failed");
-    if (base_index_ > 0) {
-      Buf hdr;  // pin the absolute index of the first record
-      hdr.u32(kLogHeaderMagic);
-      hdr.u64(base_index_ + 1);
-      write_all(f, hdr.s);
-    }
+    Buf hdr;  // v2: absolute index of the first record + CRC framing
+    hdr.u32(kLogHeaderMagicV2);
+    hdr.u64(base_index_ + 1);
+    write_all(f, hdr.s);
     for (const auto& e : entries_) write_all(f, encode_entry(e));
     if (::fsync(f) != 0) die("log rewrite fsync failed");
     ::close(f);
@@ -335,13 +351,30 @@ class RaftLog {
     if (!f) return;
     std::string all((std::istreambuf_iterator<char>(f)),
                     std::istreambuf_iterator<char>());
-    size_t off = 0;
-    uint64_t start_index = 1;  // headerless legacy files start at 1
-    if (all.size() >= 12) {
-      Reader hdr(all.data(), 12);
-      if (hdr.u32() == kLogHeaderMagic) {
-        start_index = hdr.u64();
-        off = 12;
+    if (all.empty()) return;
+    // Every durable log begins with a complete v2 header: the header
+    // and the first record share the first append's write+fsync, and
+    // nothing is acked before that fsync returns — so a file whose
+    // header is missing/torn/unknown provably contains NO acked data
+    // and is dropped whole (truncated; the next append re-writes the
+    // header). There is deliberately NO cross-format compat: a log
+    // never outlives its cluster in this framework (clusters are
+    // per-run), so an unknown magic is a torn first write, not an
+    // old version (round-4 review: a half-versioned "legacy" path
+    // misread same-session files and baked the misparse in).
+    size_t off = 12;
+    uint64_t start_index = 1;
+    {
+      bool ok_header = all.size() >= 12;
+      if (ok_header) {
+        Reader hdr(all.data(), 12);
+        ok_header = hdr.u32() == kLogHeaderMagicV2;
+        if (ok_header) start_index = hdr.u64();
+      }
+      if (!ok_header) {
+        if (::truncate(log_path().c_str(), 0) != 0)
+          die("log torn-header truncate failed");
+        return;
       }
     }
     if (start_index > base_index_ + 1) {
@@ -361,59 +394,37 @@ class RaftLog {
       uint32_t len = hdr.u32();
       // Recovery discriminator (round-4 review iterations). Trailing-
       // prefix DROP is sound only for what a crash mid-append leaves —
-      // fsync ordering proves any ACKED record fully on disk, so a
-      // torn record is by construction the final, unacked one. Rot of
-      // synced bytes (dying disk) is a persistence anomaly on acked
-      // data and must FAIL-STOP (same stance as write-time failure):
-      //   * length promising more bytes than the file holds →
-      //     incomplete append: drop. (Residual ambiguity: a length
-      //     field rotted to a huge value looks identical; the
-      //     per-record CRC below cannot check an incomplete record.
-      //     This is the one rot shape still read as a torn tail.)
-      //   * sub-minimum length over ALL-ZERO remainder → OS-crash
-      //     zero-fill: drop.
-      //   * sub-minimum length amid non-zero bytes → rotted length
-      //     field: die.
-      //   * complete record whose CRC mismatches → torn only when it
-      //     is the FINAL record (partial flush of the last append);
-      //     mid-file it is body/term rot — decoding it would feed
-      //     garbage to the state machine: die.
-      if (off + 4 + len > all.size()) break;
-      if (len < kMinRecordLen) {
-        for (size_t i = off; i < all.size(); ++i)
-          if (all[i] != 0) {
-            errno = EIO;
-            die("log record corrupt mid-file (acked data rotted)");
-          }
-        break;  // zero-fill torn tail
+      // fsync ordering proves any ACKED record fully on disk, so a torn
+      // record is by construction the FINAL, unacked one. The test for
+      // "final" makes no assumption about WHICH pages of the torn
+      // append persisted (writeback is unordered: a zeroed length field
+      // under surviving body bytes, or vice versa, are both one torn
+      // append): a bad record is a droppable torn tail iff NO
+      // CRC-valid record follows it anywhere in the file
+      // (_valid_record_follows). A valid record after the bad region
+      // proves the bad bytes sit amid acked data — rot of synced bytes
+      // (dying disk), a persistence anomaly that must FAIL-STOP like a
+      // write-time failure (truncating would durably destroy the acked
+      // suffix behind it).
+      bool bad = len < kMinRecordLen || off + 4 + len > all.size();
+      if (!bad) {
+        Reader tail(all.data() + off + len, 4);  // record's last 4 bytes
+        bad = tail.u32() != crc32(all.data() + off + 4, len - 4);
       }
-      Reader body(all.data() + off + 4, len);
-      uint64_t term = body.u64();
-      uint8_t type = body.u8();
-      Bytes data(all.data() + off + 4 + 9, len - kMinRecordLen);
-      Reader tail(all.data() + off + len, 4);  // last 4 bytes of record
-      if (tail.u32() != crc32(all.data() + off + 4, len - 4)) {
-        // Droppable torn FINAL append: nothing but (optional zero-fill)
-        // after it — a torn body and a zero-extended file are artifacts
-        // of the same unacked crash (review repro: both at once used to
-        // take the mid-file branch and wedge the node). Any NON-zero
-        // byte after a CRC-bad record means acked data follows rot: die.
-        bool tail_only = true;
-        for (size_t i = off + 4 + len; i < all.size(); ++i)
-          if (all[i] != 0) {
-            tail_only = false;
-            break;
-          }
-        if (tail_only) break;  // torn final append (+ zero-fill)
-        errno = EIO;
-        die("log record crc mismatch mid-file (acked data rotted)");
+      if (bad) {
+        if (_valid_record_follows(all, off + 4)) {
+          errno = EIO;
+          die("log record corrupt mid-file (acked data rotted)");
+        }
+        break;  // torn tail (any page-persistence order) — drop
       }
       ++idx;
       if (idx > base_index_) {
+        Reader body(all.data() + off + 4, len);
         LogEntry e;
-        e.term = term;
-        e.type = type;
-        e.data = std::move(data);
+        e.term = body.u64();
+        e.type = body.u8();
+        e.data = Bytes(all.data() + off + 4 + 9, len - kMinRecordLen);
         entries_.push_back(std::move(e));
       }
       off += 4 + len;
@@ -432,6 +443,30 @@ class RaftLog {
       if (::fsync(f) != 0) die("log torn-tail fsync failed");
       ::close(f);
     }
+  }
+
+  // Does any CRC-VALID record start anywhere in all[from..)? The resync
+  // probe behind the torn-tail/rot discriminator: a valid record after
+  // a bad one proves the bad bytes sit amid acked data (appends are
+  // strictly sequential), while a torn final append has no valid
+  // follower no matter which of its pages persisted. Cheap in practice:
+  // a candidate offset only costs a CRC when its 4 length bytes decode
+  // to a plausible in-bounds record (random/zero bytes almost never
+  // do). Residual false-positive: the scan walks THROUGH the bad
+  // record's own bytes, so client data that embeds a full CRC-valid
+  // record image inside a torn append would read as mid-file rot and
+  // fail-stop — an availability (never a safety) error, requiring an
+  // adversarially crafted value to tear at exactly the wrong moment.
+  bool _valid_record_follows(const Bytes& all, size_t from) const {
+    if (all.size() < kMinRecordLen + 4) return false;
+    for (size_t p = from; p + 4 + kMinRecordLen <= all.size(); ++p) {
+      Reader hdr(all.data() + p, 4);
+      uint32_t len = hdr.u32();
+      if (len < kMinRecordLen || p + 4 + len > all.size()) continue;
+      Reader tail(all.data() + p + len, 4);
+      if (tail.u32() == crc32(all.data() + p + 4, len - 4)) return true;
+    }
+    return false;
   }
 };
 

@@ -91,8 +91,8 @@ int main(int argc, char** argv) {
       std::fstream f(d + "/log",
                      std::ios::binary | std::ios::in | std::ios::out);
       raftnative::Buf bad;
-      bad.u32(3);  // sub-minimum length over record #1's intact header
-      f.seekp(0);
+      bad.u32(3);  // sub-minimum length over record #1 (post v2 header)
+      f.seekp(12);
       f.write(bad.s.data(), static_cast<std::streamsize>(bad.s.size()));
       f.close();
       RaftLog log;
@@ -109,7 +109,7 @@ int main(int argc, char** argv) {
       { RaftLog log; log.open(dir, "rotten-body"); fill(log); }
       std::fstream f(d + "/log",
                      std::ios::binary | std::ios::in | std::ios::out);
-      f.seekp(4 + 8);  // first record's type/data region
+      f.seekp(12 + 4 + 8);  // record #1's type byte (after v2 header+len+term)
       f.write("X", 1);
       f.close();
       RaftLog log;
@@ -128,8 +128,8 @@ int main(int argc, char** argv) {
       ::mkdir(dir.c_str(), 0755);
       ::mkdir(d.c_str(), 0755);
       std::ofstream lf(d + "/log", std::ios::binary);
-      raftnative::Buf hdr;  // wire-endian, like the real writer
-      hdr.u32(0xFFFFFFFFu);
+      raftnative::Buf hdr;  // wire-endian v2 header, like the real writer
+      hdr.u32(0xFFFFFFFEu);
       hdr.u64(10);
       lf.write(hdr.s.data(), static_cast<std::streamsize>(hdr.s.size()));
       lf.close();
@@ -262,6 +262,30 @@ int main(int argc, char** argv) {
       log.open(dir, "torn-crc-zero");
       CHECK(log.last_index() == 5);
       CHECK(log.at(5).data == "h");
+    }
+    // 6e. File without a complete v2 header (torn first write, or an
+    //     unknown format): provably contains no acked data — dropped
+    //     whole, and the next append re-creates a well-formed file.
+    //     (There is deliberately no cross-format compat: a log never
+    //     outlives its cluster in this framework.)
+    {
+      std::string d = dir + "/torn-header";
+      ::mkdir(d.c_str(), 0755);
+      {
+        std::ofstream f(d + "/log", std::ios::binary);
+        f.write("\xff\xff\xff", 3);  // torn header fragment
+      }
+      {
+        RaftLog log;
+        log.open(dir, "torn-header");
+        CHECK(log.last_index() == 0);
+        log.append(entry(1, "a"));
+        CHECK(log.last_index() == 1);
+      }
+      RaftLog log;
+      log.open(dir, "torn-header");
+      CHECK(log.last_index() == 1);
+      CHECK(log.at(1).data == "a");
     }
     // 7. File truncated mid-record (torn write of the LAST record):
     //    the complete prefix is recovered.

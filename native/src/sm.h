@@ -15,6 +15,7 @@
 // local applied state immediately.
 #pragma once
 
+#include <cstdio>
 #include <map>
 #include <mutex>
 #include <string>
@@ -27,6 +28,19 @@ namespace raftnative {
 class MapStateMachine : public StateMachine {
  public:
   Bytes apply(const Bytes& op) override {
+    try {
+      return apply_inner(op);
+    } catch (const WireError& e) {
+      // Truncated committed op: same stance as the unknown-opcode
+      // default below — deterministic no-op, never an applier-thread
+      // abort (round-4 fuzz finding).
+      std::fprintf(stderr, "[sm] WARNING: malformed committed op "
+                           "ignored: %s\n", e.what());
+      return {};
+    }
+  }
+
+  Bytes apply_inner(const Bytes& op) {
     Reader r(op);
     uint8_t cmd = r.u8();
     std::lock_guard<std::mutex> g(mu_);
@@ -53,26 +67,63 @@ class MapStateMachine : public StateMachine {
         return b.s;
       }
       default:
-        throw WireError("map: bad opcode");
+        // A committed op that does not decode: validation at `receive`
+        // makes this unreachable for client traffic, so reaching it
+        // means log divergence/corruption — but THROWING here turned a
+        // single malformed entry into a replicated poison pill that
+        // crashed every node and re-crashed them on restart replay
+        // (round-4 fuzz finding; the applier thread has no handler).
+        // Deterministic no-op on all nodes is the safe semantic.
+        return {};
     }
   }
 
   Result receive(const Bytes& body, const SubmitFn& submit) override {
-    Reader r(body);
-    uint8_t cmd = r.u8();
-    if (cmd == wire::MAP_GET) {
-      uint64_t key = r.u64();
-      bool quorum = r.u8() != 0;
-      if (!quorum) {
-        std::lock_guard<std::mutex> g(mu_);
-        return Result::success(encode_get(key));  // dirty read: local state
+    // Strict boundary validation (round-4 fuzz finding): ops are parsed
+    // and re-encoded CANONICALLY before submit, so nothing enters the
+    // replicated log that `apply` cannot decode — a raw forward let a
+    // garbage client frame through consensus and onto every applier.
+    try {
+      Reader r(body);
+      uint8_t cmd = r.u8();
+      if (cmd == wire::MAP_GET) {
+        uint64_t key = r.u64();
+        bool quorum = r.u8() != 0;
+        if (!quorum) {
+          std::lock_guard<std::mutex> g(mu_);
+          return Result::success(encode_get(key));  // dirty read: local
+        }
+        Buf op;  // quorum read: strip the flag, run GET through the log
+        op.u8(wire::MAP_GET);
+        op.u64(key);
+        return submit(op.s);
       }
-      Buf op;  // quorum read: strip the flag, run the GET through the log
-      op.u8(wire::MAP_GET);
-      op.u64(key);
-      return submit(op.s);
+      if (cmd == wire::MAP_PUT) {
+        uint64_t key = r.u64();
+        int64_t val = r.i64();
+        Buf op;
+        op.u8(wire::MAP_PUT);
+        op.u64(key);
+        op.i64(val);
+        return submit(op.s);
+      }
+      if (cmd == wire::MAP_CAS) {
+        uint64_t key = r.u64();
+        int64_t from = r.i64();
+        int64_t to = r.i64();
+        Buf op;
+        op.u8(wire::MAP_CAS);
+        op.u64(key);
+        op.i64(from);
+        op.i64(to);
+        return submit(op.s);
+      }
+      return Result::error(wire::ERR_SERVER, "map: bad opcode");
+    } catch (const WireError& e) {
+      return Result::error(wire::ERR_SERVER,
+                           std::string("map: malformed request: ") +
+                               e.what());
     }
-    return submit(body);  // PUT / CAS always replicate
   }
 
   void save(std::ostream& out) override {
@@ -117,6 +168,16 @@ class MapStateMachine : public StateMachine {
 class CounterStateMachine : public StateMachine {
  public:
   Bytes apply(const Bytes& op) override {
+    try {
+      return apply_inner(op);
+    } catch (const WireError& e) {  // see MapStateMachine::apply
+      std::fprintf(stderr, "[sm] WARNING: malformed committed op "
+                           "ignored: %s\n", e.what());
+      return {};
+    }
+  }
+
+  Bytes apply_inner(const Bytes& op) {
     Reader r(op);
     uint8_t cmd = r.u8();
     std::string name = r.str();
@@ -145,28 +206,56 @@ class CounterStateMachine : public StateMachine {
         return b.s;
       }
       default:
-        throw WireError("counter: bad opcode");
+        // See MapStateMachine::apply — a malformed COMMITTED op must be
+        // a deterministic no-op, never a replicated poison pill.
+        return {};
     }
   }
 
   Result receive(const Bytes& body, const SubmitFn& submit) override {
-    Reader r(body);
-    uint8_t cmd = r.u8();
-    if (cmd == wire::CTR_GET) {
+    // Strict boundary validation + canonical re-encode before submit —
+    // see MapStateMachine::receive (round-4 fuzz finding).
+    try {
+      Reader r(body);
+      uint8_t cmd = r.u8();
       std::string name = r.str();
-      bool quorum = r.u8() != 0;
-      if (!quorum) {
-        std::lock_guard<std::mutex> g(mu_);
-        Buf b;
-        b.i64(counters_[name]);
-        return Result::success(b.s);
+      if (cmd == wire::CTR_GET) {
+        bool quorum = r.u8() != 0;
+        if (!quorum) {
+          std::lock_guard<std::mutex> g(mu_);
+          Buf b;
+          b.i64(counters_[name]);
+          return Result::success(b.s);
+        }
+        Buf op;
+        op.u8(wire::CTR_GET);
+        op.str(name);
+        return submit(op.s);
       }
-      Buf op;
-      op.u8(wire::CTR_GET);
-      op.str(name);
-      return submit(op.s);
+      if (cmd == wire::CTR_ADD || cmd == wire::CTR_ADD_AND_GET) {
+        int64_t delta = r.i64();
+        Buf op;
+        op.u8(cmd);
+        op.str(name);
+        op.i64(delta);
+        return submit(op.s);
+      }
+      if (cmd == wire::CTR_CAS) {
+        int64_t expect = r.i64();
+        int64_t update = r.i64();
+        Buf op;
+        op.u8(wire::CTR_CAS);
+        op.str(name);
+        op.i64(expect);
+        op.i64(update);
+        return submit(op.s);
+      }
+      return Result::error(wire::ERR_SERVER, "counter: bad opcode");
+    } catch (const WireError& e) {
+      return Result::error(wire::ERR_SERVER,
+                           std::string("counter: malformed request: ") +
+                               e.what());
     }
-    return submit(body);
   }
 
   void save(std::ostream& out) override {

@@ -370,4 +370,4 @@ def test_log_selftest_failstop_on_body_rot(tmp_path):
          "rotten-body"],
         capture_output=True, text=True, timeout=30)
     assert out.returncode != 0
-    assert "crc mismatch mid-file" in out.stderr
+    assert "corrupt mid-file" in out.stderr
