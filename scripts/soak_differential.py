@@ -65,6 +65,17 @@ def parse_args(argv=None):
                         "batching exercises the shared-window packing)")
     p.add_argument("--strict-unknown", action="store_true",
                    help="treat product-path unknown verdicts as failures")
+    p.add_argument("--product-algorithm", default="auto",
+                   choices=["auto", "jax", "pallas", "race", "dfs"],
+                   help="algorithm for the product path — soaks every "
+                        "engine behind the same oracle (default auto)")
+    p.add_argument("--pin-capacity", type=int, default=None,
+                   help="pin the sort-frontier kernel's capacity ladder "
+                        "(n_configs) — routes kernel-checked histories "
+                        "through the general sort kernel instead of the "
+                        "dense planner (auto's wide-window DFS rung still "
+                        "applies; incompatible with pallas/dfs, which "
+                        "would silently ignore or bypass the pin)")
     p.add_argument("--platform", default="cpu", choices=["cpu", "default"],
                    help="cpu (default; pinned 8-device host mesh, "
                         "reproducible anywhere) or default backend (TPU "
@@ -74,6 +85,16 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.pin_capacity is not None and \
+            args.product_algorithm in ("pallas", "dfs"):
+        # A pinned capacity disables dense-group planning, so "pallas"
+        # would silently measure the sort kernel (and dfs ignores the
+        # pin entirely) — refuse rather than produce mislabeled
+        # evidence (round-4 review finding).
+        print("--pin-capacity is incompatible with "
+              f"--product-algorithm {args.product_algorithm}",
+              file=sys.stderr)
+        return 2
     if args.platform == "cpu":
         pin_cpu(8)
 
@@ -176,7 +197,8 @@ def main(argv=None) -> int:
                 continue
             model = models[wl]()
             results = check_histories([h for _, h, _ in rows], model,
-                                      algorithm="auto")
+                                      algorithm=args.product_algorithm,
+                                      n_configs=args.pin_capacity)
             for (i, h, was_corrupted), res in zip(rows, results):
                 n_done += 1
                 n_corrupted += was_corrupted
