@@ -63,3 +63,48 @@ def test_serve_index_lists_runs(tmp_path):
     assert "20260729T000000" in page and "valid" in page
     assert "INVALID" in page  # the failing run is flagged
     assert "history.jsonl" in page
+
+
+def test_serve_http_end_to_end(tmp_path):
+    """The results server over real HTTP: index lists a recorded run
+    with its verdict badge, artifact files are fetchable, and path
+    traversal stays confined to the store root (the reference's
+    `lein run serve` capability, raft.clj:98-101)."""
+    import threading
+    import urllib.error
+    import urllib.request
+    from functools import partial
+    from http.server import ThreadingHTTPServer
+
+    from jepsen_jgroups_raft_tpu.core.serve import _Handler
+
+    d = tmp_path / "store" / "demo" / "t1"
+    d.mkdir(parents=True)
+    (d / "results.json").write_text(json.dumps({"valid?": True}))
+    (d / "history.jsonl").write_text("{}\n")
+    (tmp_path / "secret.txt").write_text("outside the store root")
+
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), partial(_Handler,
+                                  store_root=(tmp_path / "store").resolve()))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        index = urllib.request.urlopen(f"{base}/", timeout=5).read().decode()
+        assert "demo/t1" in index and "valid" in index
+        hist = urllib.request.urlopen(
+            f"{base}/demo/t1/history.jsonl", timeout=5).read()
+        assert hist == b"{}\n"
+        # Traversal attempts must not escape the store root.
+        for evil in ("/../secret.txt", "/%2e%2e/secret.txt"):
+            try:
+                body = urllib.request.urlopen(
+                    f"{base}{evil}", timeout=5).read()
+                assert b"outside the store root" not in body
+            except urllib.error.HTTPError:
+                pass  # 404 is the right answer too
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
