@@ -55,6 +55,11 @@ def _add_test_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--stale-reads", action="store_true",
                    help="allow dirty local reads (raft.clj:14-17; "
                         "quorum_reads = not stale_reads, raft.clj:92)")
+    p.add_argument("--weak-election", action="store_true",
+                   help="election workload: drop back to the reference-"
+                        "parity single-client model (leader.clj:58-62) "
+                        "instead of the default cross-node majority "
+                        "checker")
     p.add_argument("--time-limit", type=float, default=DEFAULTS["time_limit"],
                    help="main-phase duration, seconds")
     p.add_argument("--quiesce", type=float, default=DEFAULTS["quiesce"],
@@ -163,8 +168,10 @@ def cmd_test(args) -> int:
             "algorithm": args.algorithm,
         }
         if args.workload == "election":
-            # Opt-in majority model: wired whenever the deployment can
-            # snapshot every node's view (local + ssh clusters can).
+            # Default-on majority model: wired whenever the deployment
+            # can snapshot every node's view (local + ssh clusters can);
+            # --weak-election drops back to reference parity.
+            opts["weak_election"] = args.weak_election
             probe = getattr(db, "cluster", None)
             probe = getattr(probe, "views_probe", None)
             if probe is not None:

@@ -120,16 +120,26 @@ class MajorityLeaderModel(LeaderModel):
                               f"for term {bad_term}: {leaders}")
             result["term"] = int(bad_term)
         for node, snaps in sorted(by_node.items()):
-            # Sweep in completion order; compare each snapshot only
-            # against the max term of snapshots that happened-before it
-            # (completed before its invocation).
+            # Compare each snapshot only against the max term of
+            # snapshots that happened-before it (completed before its
+            # invocation). Two-pointer sweep — snapshots in invocation
+            # order, a completion-ordered cursor carrying the running
+            # max — keeps this O(n log n); the naive per-snapshot
+            # rescan was O(n^2) and, now that this model is the
+            # DEFAULT, sat on every election run's checking path
+            # (round-5 review finding).
             done = sorted(snaps, key=lambda s: s[1])
+            k = 0
+            run_max = None
             for inv_j, _, term_j in sorted(snaps):
-                prior = [t for _, okp, t in done if okp < inv_j]
-                if prior and term_j < max(prior):
+                while k < len(done) and done[k][1] < inv_j:
+                    t = done[k][2]
+                    run_max = t if run_max is None else max(run_max, t)
+                    k += 1
+                if run_max is not None and term_j < run_max:
                     result["valid?"] = False
                     result["error"] = (
-                        f"node {node} term went backward: {max(prior)} "
+                        f"node {node} term went backward: {run_max} "
                         f"-> {term_j} across non-overlapping snapshots")
                     return result
         result["view-count"] = int(sum(len(t) for t in by_node.values()))
