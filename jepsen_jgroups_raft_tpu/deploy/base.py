@@ -10,6 +10,7 @@ waits, teardown cleanup, and log collection.
 
 from __future__ import annotations
 
+import inspect
 import random
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
@@ -28,12 +29,39 @@ def collect_views(probe, members, timeout: float = 0.75) -> list:
     op runs inside a worker's operation slot, and sequential 2 s-default
     probes of a 5-node partitioned cluster would block that worker ~10 s
     — past the workloads' operation timeout, skewing op mix and latency
-    stats during faults (round-3 advisor finding)."""
+    stats during faults (round-3 advisor finding).
+
+    `probe` contract: ``probe(node) -> (leader, term) | None``; a
+    ``timeout=`` keyword is passed when the callable accepts one (both
+    in-repo cluster probes do), otherwise the probe's own default
+    timeout applies (ADVICE r4: external probes without the kwarg must
+    not TypeError)."""
     members = list(members)
     if not members:
         return []
+    try:
+        sig = inspect.signature(probe)
+        takes_timeout = "timeout" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values())
+    except (TypeError, ValueError):  # signature-opaque (C/builtin):
+        takes_timeout = True         # optimistic, with call-time retry
+
+    def call(n, _tt=takes_timeout):
+        if _tt:
+            try:
+                return probe(n, timeout=timeout)
+            except TypeError:
+                # Signature-opaque callable that turned out not to take
+                # the kwarg (round-5 review: introspection alone still
+                # crashed exactly the case the fix targets). A genuine
+                # TypeError from inside a timeout-taking probe re-raises
+                # below on the retry.
+                pass
+        return probe(n)
+
     with ThreadPoolExecutor(max_workers=len(members)) as pool:
-        views = pool.map(lambda n: probe(n, timeout=timeout), members)
+        views = pool.map(call, members)
     out = []
     for n, v in zip(members, views):
         if v is not None and v[0] is not None:

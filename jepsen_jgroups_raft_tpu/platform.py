@@ -40,6 +40,21 @@ def pin_cpu(n_devices: int = 8) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def reset_backends() -> None:
+    """Drop cached device backends so the next use re-initializes under
+    the current ``jax_platforms`` pin.
+
+    Needed by the mid-flight degrade path: a TPU backend that initialized
+    successfully and THEN lost its tunnel (UNAVAILABLE during execution)
+    stays cached, so a retry without this re-hits the dead backend even
+    after pin_cpu(). Init-time failures never cache a backend, so the
+    call is a no-op there (ADVICE r4).
+    """
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+
+
 def is_backend_init_failure(e: BaseException) -> bool:
     """True for the failure flavors of an unusable accelerator backend:
     init refusal (plugin unregistered / unknown platform) and the

@@ -163,6 +163,7 @@ class RaftLog {
   std::string meta_path() const { return dir_ + "/meta"; }
   std::string log_path() const { return dir_ + "/log"; }
   std::string snap_path() const { return dir_ + "/snap"; }
+  std::string synced_path() const { return dir_ + "/synced"; }
 
   // Durability: votes and entries are fsync'd (file AND directory) before
   // they are acted on — a persisted vote/append must survive not just
@@ -195,6 +196,58 @@ class RaftLog {
     if (d < 0) die("log dir open failed");
     if (::fsync(d) != 0) die("log dir fsync failed");
     ::close(d);
+  }
+
+  // ---- synced-length sidecar (ADVICE r4) ------------------------------
+  // After every log fsync, the synced file length is recorded in a
+  // 12-byte CRC-guarded sidecar (u64 len | u32 crc). The sidecar itself
+  // is a plain single-sector pwrite — NO fsync — which still yields the
+  // one-directional invariant recovery needs: the write happens only
+  // AFTER the log fsync returned, so any persisted claim N proves log
+  // bytes [0, N) are durably acked; a stale (or lost) claim merely
+  // degrades recovery to the heuristic discriminator. Shrinking rewrites
+  // drop the sidecar DURABLY (unlink + dir fsync) before the new file is
+  // renamed in, so a claim can never name bytes of a longer, replaced
+  // generation. Net effect: rot of the FINAL acked record — previously
+  // indistinguishable from a torn unacked append and silently truncated
+  // — now fail-stops whenever the sidecar is fresh; the residual window
+  // is one crash landing between a record's fsync and its 12-byte
+  // sidecar update (plus OS-crash loss of the unsynced sidecar page).
+  void persist_synced(uint64_t len) {
+    if (dir_.empty()) return;
+    Buf b;
+    b.u64(len);
+    b.u32(crc32(b.s.data(), 8));
+    int f = ::open(synced_path().c_str(), O_WRONLY | O_CREAT, 0644);
+    if (f < 0) die("synced sidecar open failed");
+    if (::pwrite(f, b.s.data(), b.s.size(), 0) !=
+        static_cast<ssize_t>(b.s.size()))
+      die("synced sidecar write failed");
+    ::close(f);
+  }
+
+  // Durable removal: must be on disk BEFORE a shrinking rewrite's rename
+  // lands (metadata ops are unordered without the dir fsync).
+  void drop_synced() {
+    if (dir_.empty()) return;
+    if (::unlink(synced_path().c_str()) != 0 && errno != ENOENT)
+      die("synced sidecar unlink failed");
+    fsync_dir();
+  }
+
+  // 0 when absent/torn (CRC guards the non-atomic write) — recovery
+  // then falls back to the follower-scan heuristic, i.e. the sidecar
+  // only ever ADDS discrimination, never subtracts safety.
+  uint64_t load_synced() const {
+    std::ifstream f(synced_path(), std::ios::binary);
+    if (!f) return 0;
+    std::string all((std::istreambuf_iterator<char>(f)),
+                    std::istreambuf_iterator<char>());
+    if (all.size() < 12) return 0;
+    Reader r(all.data(), 12);
+    uint64_t len = r.u64();
+    if (r.u32() != crc32(all.data(), 8)) return 0;
+    return len;
   }
 
   void persist_meta() {
@@ -286,12 +339,20 @@ class RaftLog {
     }
     write_all(f, encode_entry(e));
     if (::fsync(f) != 0) die("log fsync failed");
+    off_t end = ::lseek(f, 0, SEEK_CUR);
+    if (end < 0) die("log lseek failed");
     ::close(f);
     if (fresh) fsync_dir();  // file creation must survive an OS crash
+    persist_synced(static_cast<uint64_t>(end));  // AFTER the fsync
   }
 
   void rewrite() {
     if (dir_.empty()) return;
+    // The sidecar's claim describes the OLD (possibly longer) file; it
+    // must be durably gone before the new file can be renamed in, or a
+    // crash could leave a shrunken log under a stale oversized claim
+    // (recovery would then read a genuine torn tail as acked rot).
+    drop_synced();
     std::string tmp = log_path() + ".tmp";
     int f = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
     if (f < 0) die("log rewrite open failed");
@@ -301,10 +362,13 @@ class RaftLog {
     write_all(f, hdr.s);
     for (const auto& e : entries_) write_all(f, encode_entry(e));
     if (::fsync(f) != 0) die("log rewrite fsync failed");
+    off_t end = ::lseek(f, 0, SEEK_CUR);
+    if (end < 0) die("log rewrite lseek failed");
     ::close(f);
     if (::rename(tmp.c_str(), log_path().c_str()) != 0)
       die("log rewrite rename failed");
     fsync_dir();
+    persist_synced(static_cast<uint64_t>(end));  // AFTER the rename is durable
   }
 
   void persist_snapshot() {
@@ -347,10 +411,29 @@ class RaftLog {
   }
 
   void load_entries() {
+    // The sidecar is consulted BEFORE any early return: bytes [0, claim)
+    // were durably acked, so a missing or empty log under a positive
+    // claim is TOTAL loss of acked data and must fail-stop exactly like
+    // partial loss (round-5 review: the original ordering silently
+    // accepted rm/truncate-to-0 while aborting on truncate-by-3).
+    uint64_t synced_claim = load_synced();
     std::ifstream f(log_path(), std::ios::binary);
-    if (!f) return;
+    if (!f) {
+      if (synced_claim > 0) {
+        errno = EIO;
+        die("log file missing but sidecar claims acked bytes");
+      }
+      return;
+    }
     std::string all((std::istreambuf_iterator<char>(f)),
                     std::istreambuf_iterator<char>());
+    if (synced_claim > all.size()) {
+      // Covers the empty file too: the acked extent is gone (external
+      // truncation or a dying disk dropping synced pages) — truncating
+      // further would compound the durable loss.
+      errno = EIO;
+      die("log shorter than its synced-length sidecar (acked data lost)");
+    }
     if (all.empty()) return;
     // Every durable log begins with a complete v2 header: the header
     // and the first record share the first append's write+fsync, and
@@ -372,8 +455,21 @@ class RaftLog {
         if (ok_header) start_index = hdr.u64();
       }
       if (!ok_header) {
+        if (synced_claim > 0) {
+          // A log that ever acked (claim > 0 proves the first append's
+          // header+record fsync returned) has a durable v2 header; bad
+          // header bytes under a valid claim are ROT of acked data, not
+          // a torn first write — fail-stop, don't truncate.
+          errno = EIO;
+          die("log header corrupt within synced extent (acked data "
+              "rotted)");
+        }
         if (::truncate(log_path().c_str(), 0) != 0)
           die("log torn-header truncate failed");
+        // claim was 0/absent here, so a crash between the truncate and
+        // this unlink cannot set up a false fail-stop on the next load.
+        if (::unlink(synced_path().c_str()) != 0 && errno != ENOENT)
+          die("log torn-header sidecar unlink failed");
         return;
       }
     }
@@ -392,29 +488,76 @@ class RaftLog {
     while (off + 4 <= all.size()) {
       Reader hdr(all.data() + off, 4);
       uint32_t len = hdr.u32();
-      // Recovery discriminator (round-4 review iterations). Trailing-
-      // prefix DROP is sound only for what a crash mid-append leaves —
-      // fsync ordering proves any ACKED record fully on disk, so a torn
-      // record is by construction the FINAL, unacked one. The test for
-      // "final" makes no assumption about WHICH pages of the torn
-      // append persisted (writeback is unordered: a zeroed length field
-      // under surviving body bytes, or vice versa, are both one torn
-      // append): a bad record is a droppable torn tail iff NO
-      // CRC-valid record follows it anywhere in the file
-      // (_valid_record_follows). A valid record after the bad region
-      // proves the bad bytes sit amid acked data — rot of synced bytes
-      // (dying disk), a persistence anomaly that must FAIL-STOP like a
-      // write-time failure (truncating would durably destroy the acked
-      // suffix behind it).
+      // Recovery discriminator (round-4 review iterations; sidecar +
+      // extent refinement ADVICE r4). Trailing-prefix DROP is sound
+      // only for what a crash mid-append leaves — fsync ordering proves
+      // any ACKED record fully on disk, so a torn record can only be
+      // the FINAL append. Two tiers decide whether a bad record is that
+      // droppable torn tail or rot of acked bytes (which must FAIL-STOP
+      // — truncating would durably destroy the acked suffix):
+      //   1. EXACT: the synced-length sidecar. A bad record starting
+      //      below the claim was acked in full → rot. This is the only
+      //      tier that can catch rot of the FINAL acked record (there
+      //      is no follower to scan for); without it that case is
+      //      indistinguishable from a torn append and gets truncated —
+      //      the residual is now just a stale sidecar (crash between a
+      //      record's fsync and the 12-byte sidecar write, or an
+      //      OS-crash losing the unsynced sidecar page).
+      //   2. HEURISTIC: a CRC-valid record following the bad one proves
+      //      the bad bytes sit amid acked data. Makes no assumption
+      //      about WHICH pages of a torn append persisted (writeback is
+      //      unordered: zeroed length under surviving body bytes, or
+      //      vice versa, are both one torn append).
       bool bad = len < kMinRecordLen || off + 4 + len > all.size();
       if (!bad) {
         Reader tail(all.data() + off + len, 4);  // record's last 4 bytes
         bad = tail.u32() != crc32(all.data() + off + 4, len - 4);
       }
       if (bad) {
-        if (_valid_record_follows(all, off + 4)) {
+        char msg[128];
+        // Exact discriminator first: the sidecar's claim is a record
+        // boundary, so a bad record STARTING below it was acked in
+        // full — its badness is rot of synced bytes, never a torn
+        // append. This is what catches rot of the FINAL acked record
+        // (no follower exists to scan for). Offset in the message so
+        // an operator can inspect/truncate deliberately (ADVICE r4).
+        if (off < synced_claim) {
           errno = EIO;
-          die("log record corrupt mid-file (acked data rotted)");
+          std::snprintf(msg, sizeof msg,
+                        "log record corrupt at byte %zu, within synced "
+                        "extent %llu (acked data rotted)", off,
+                        static_cast<unsigned long long>(synced_claim));
+          die(msg);
+        }
+        // Heuristic fallback (stale/absent sidecar): a CRC-valid record
+        // after the bad one proves the bad bytes sit amid acked data.
+        // The bad record's own payload is excused from that scan ONLY
+        // in the torn-final-append shape — a plausible length whose
+        // claimed extent ends EXACTLY at EOF (appends are sequential,
+        // so a torn final append is the last thing in the file) — so
+        // client data embedding a valid record image inside a torn
+        // append does not wedge recovery as false rot (ADVICE r4).
+        // Every other shape scans the WHOLE remainder from off+4:
+        // an extent overrunning EOF or ending short of it means either
+        // the length field itself tore/rotted or acked data follows —
+        // in both cases the intact followers the extent would have
+        // covered must stay visible to the scan (round-5 review ×2:
+        // trusting an in-bounds or clamped rotted length skipped the
+        // followers and silently truncated acked entries). Residuals,
+        // both requiring adversarially precise corruption, both
+        // availability-not-safety: a mid-file length rotted to land
+        // exactly on EOF reads as torn tail; an embedded image inside
+        // a torn append that ALSO gained a trailing extension (so its
+        // extent is not EOF-exact) reads as rot and fail-stops with
+        // the offset logged for manual recovery.
+        bool torn_final_shape =
+            len >= kMinRecordLen && off + 4 + len == all.size();
+        if (!torn_final_shape && _valid_record_follows(all, off + 4)) {
+          errno = EIO;
+          std::snprintf(msg, sizeof msg,
+                        "log record corrupt at byte %zu, valid record "
+                        "follows (acked data rotted)", off);
+          die(msg);
         }
         break;  // torn tail (any page-persistence order) — drop
       }
@@ -442,6 +585,7 @@ class RaftLog {
       if (f < 0) die("log open for torn-tail fsync failed");
       if (::fsync(f) != 0) die("log torn-tail fsync failed");
       ::close(f);
+      persist_synced(off);  // the survivor prefix is now the synced extent
     }
   }
 
@@ -449,14 +593,20 @@ class RaftLog {
   // probe behind the torn-tail/rot discriminator: a valid record after
   // a bad one proves the bad bytes sit amid acked data (appends are
   // strictly sequential), while a torn final append has no valid
-  // follower no matter which of its pages persisted. Cheap in practice:
+  // follower no matter which of its pages persisted. The caller skips
+  // the scan entirely for the one shape that may excuse its own
+  // payload — a plausible length whose extent ends exactly at EOF (the
+  // torn-final-append shape, ADVICE r4); every other bad record scans
+  // from its own payload start so intact acked followers stay visible
+  // (round-5 review ×2). Cheap in practice:
   // a candidate offset only costs a CRC when its 4 length bytes decode
   // to a plausible in-bounds record (random/zero bytes almost never
-  // do). Residual false-positive: the scan walks THROUGH the bad
-  // record's own bytes, so client data that embeds a full CRC-valid
-  // record image inside a torn append would read as mid-file rot and
-  // fail-stop — an availability (never a safety) error, requiring an
-  // adversarially crafted value to tear at exactly the wrong moment.
+  // do). Residual false-positive: when the bad record's LENGTH FIELD
+  // itself is torn (sub-minimum), the extent is unknowable and the scan
+  // walks the whole remainder — an embedded image there still reads as
+  // rot and fail-stops, with the offset logged for manual truncation —
+  // an availability (never a safety) error requiring an adversarially
+  // crafted value torn at exactly the wrong moment.
   bool _valid_record_follows(const Bytes& all, size_t from) const {
     if (all.size() < kMinRecordLen + 4) return false;
     for (size_t p = from; p + 4 + kMinRecordLen <= all.size(); ++p) {

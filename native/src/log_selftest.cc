@@ -118,6 +118,138 @@ int main(int argc, char** argv) {
                            "fail-stopping\n");
       return 1;
     }
+    if (argc > 2 && std::string(argv[2]) == "rot-final") {
+      // Rot of the FINAL acked record. No follower exists to scan for,
+      // so only the synced-length sidecar (fresh here: the append's
+      // fsync + sidecar update both completed) can tell this apart from
+      // a torn unacked append — truncating would silently lose an
+      // acked entry on this node. Must FAIL-STOP (ADVICE r4).
+      std::string d = dir + "/rot-final";
+      { RaftLog log; log.open(dir, "rot-final"); fill(log); }
+      struct stat st;
+      CHECK(::stat((d + "/log").c_str(), &st) == 0);
+      std::fstream f(d + "/log",
+                     std::ios::binary | std::ios::in | std::ios::out);
+      f.seekp(st.st_size - 6);  // inside the LAST record's body/crc
+      f.write("??", 2);
+      f.close();
+      RaftLog log;
+      log.open(dir, "rot-final");  // must abort via the sidecar tier
+      std::fprintf(stderr, "FAIL: final-acked-record rot truncated "
+                           "instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "lost-suffix") {
+      // The log file is SHORTER than the sidecar's synced claim: the
+      // acked suffix is gone (external truncation / dying disk). Must
+      // FAIL-STOP — truncating further compounds the durable loss.
+      std::string d = dir + "/lost-suffix";
+      { RaftLog log; log.open(dir, "lost-suffix"); fill(log); }
+      struct stat st;
+      CHECK(::stat((d + "/log").c_str(), &st) == 0);
+      CHECK(::truncate((d + "/log").c_str(),
+                       static_cast<off_t>(st.st_size - 3)) == 0);
+      RaftLog log;
+      log.open(dir, "lost-suffix");  // must abort
+      std::fprintf(stderr, "FAIL: log shorter than synced sidecar "
+                           "loaded instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "lost-file") {
+      // Total loss: the log file vanished while the sidecar still
+      // claims acked bytes. Must FAIL-STOP like partial loss (round-5
+      // review: rm used to recover "cleanly", truncate-by-3 aborted).
+      std::string d = dir + "/lost-file";
+      { RaftLog log; log.open(dir, "lost-file"); fill(log); }
+      CHECK(::unlink((d + "/log").c_str()) == 0);
+      RaftLog log;
+      log.open(dir, "lost-file");  // must abort
+      std::fprintf(stderr, "FAIL: missing log under a synced sidecar "
+                           "claim loaded instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "lost-empty") {
+      // Same loss, emptied instead of removed.
+      std::string d = dir + "/lost-empty";
+      { RaftLog log; log.open(dir, "lost-empty"); fill(log); }
+      CHECK(::truncate((d + "/log").c_str(), 0) == 0);
+      RaftLog log;
+      log.open(dir, "lost-empty");  // must abort
+      std::fprintf(stderr, "FAIL: emptied log under a synced sidecar "
+                           "claim loaded instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "rot-header") {
+      // A log that ever acked has a durable v2 header; bad header bytes
+      // under a valid sidecar claim are rot of acked data — fail-stop,
+      // never the torn-first-write truncate.
+      std::string d = dir + "/rot-header";
+      { RaftLog log; log.open(dir, "rot-header"); fill(log); }
+      {
+        std::fstream f(d + "/log",
+                       std::ios::binary | std::ios::in | std::ios::out);
+        f.seekp(0);
+        f.write("\x00", 1);  // break the magic
+      }
+      RaftLog log;
+      log.open(dir, "rot-header");  // must abort
+      std::fprintf(stderr, "FAIL: rotted header under a synced sidecar "
+                           "claim truncated instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "rot-len-overrun") {
+      // Mid-file record whose LENGTH field rots to a value overrunning
+      // EOF, sidecar stale/absent: the claimed extent must NOT be
+      // trusted (round-5 review — trusting it would skip the intact
+      // acked followers and silently truncate them); the whole-remainder
+      // scan finds them and fail-stops.
+      std::string d = dir + "/rot-len-overrun";
+      { RaftLog log; log.open(dir, "rot-len-overrun"); fill(log); }
+      {
+        std::fstream f(d + "/log",
+                       std::ios::binary | std::ios::in | std::ios::out);
+        raftnative::Buf bad;
+        bad.u32(1u << 20);  // plausible (>= min) but overruns the file
+        f.seekp(12);        // record #1's length field
+        f.write(bad.s.data(), static_cast<std::streamsize>(bad.s.size()));
+        f.close();
+        CHECK(::unlink((d + "/synced").c_str()) == 0);
+      }
+      RaftLog log;
+      log.open(dir, "rot-len-overrun");  // must abort via follower scan
+      std::fprintf(stderr, "FAIL: overrunning rotted length truncated "
+                           "acked followers instead of fail-stopping\n");
+      return 1;
+    }
+    if (argc > 2 && std::string(argv[2]) == "rot-len-inbounds") {
+      // Mid-file record whose LENGTH field rots to a PLAUSIBLE,
+      // IN-BOUNDS value whose claimed extent ends before EOF (round-5
+      // review²: trusting any in-bounds extent skipped the acked
+      // followers it covered and silently truncated them). Only an
+      // extent ending EXACTLY at EOF — the torn-final-append shape —
+      // may excuse its own payload from the follower scan.
+      std::string d = dir + "/rot-len-inbounds";
+      { RaftLog log; log.open(dir, "rot-len-inbounds"); fill(log); }
+      {
+        // Each fill() record frames to 18 bytes (4 len + 8 term +
+        // 1 type + 1 data + 4 crc); record #2's length field is at
+        // 12 + 18 = 30. 32 claims an extent ending at record #4's
+        // start (30+4+32 = 66 < EOF 102).
+        std::fstream f(d + "/log",
+                       std::ios::binary | std::ios::in | std::ios::out);
+        raftnative::Buf bad;
+        bad.u32(32);
+        f.seekp(30);
+        f.write(bad.s.data(), static_cast<std::streamsize>(bad.s.size()));
+        f.close();
+        CHECK(::unlink((d + "/synced").c_str()) == 0);
+      }
+      RaftLog log;
+      log.open(dir, "rot-len-inbounds");  // must abort via follower scan
+      std::fprintf(stderr, "FAIL: in-bounds rotted length truncated "
+                           "acked followers instead of fail-stopping\n");
+      return 1;
+    }
     if (argc > 2 && std::string(argv[2]) == "failstop") {
       // A log whose header proves compaction happened but whose
       // snapshot is missing must FAIL-STOP (loading the tail at
@@ -208,7 +340,11 @@ int main(int argc, char** argv) {
     }
     // 6c. CRC mismatch on the FINAL record (partial flush of the last
     //     append: full length landed, bytes torn): dropped like any
-    //     torn tail, durable, and the intact prefix survives.
+    //     torn tail, durable, and the intact prefix survives. A real
+    //     torn append never updated the sidecar (the fsync it follows
+    //     didn't return); removing it simulates the OS-crash-lost-page
+    //     form. With a FRESH sidecar the same bytes are acked rot and
+    //     fail-stop — that's the rot-final mode above.
     {
       std::string d = dir + "/torn-crc";
       { RaftLog log; log.open(dir, "torn-crc"); fill(log); }
@@ -220,6 +356,7 @@ int main(int argc, char** argv) {
         f.seekp(st.st_size - 6);  // inside the LAST record's body/crc
         f.write("??", 2);
         f.close();
+        CHECK(::unlink((d + "/synced").c_str()) == 0);
       }
       {
         RaftLog log;
@@ -251,6 +388,8 @@ int main(int argc, char** argv) {
         std::ofstream a(d + "/log", std::ios::binary | std::ios::app);
         const char zeros[8] = {0};
         a.write(zeros, sizeof zeros);
+        a.close();
+        CHECK(::unlink((d + "/synced").c_str()) == 0);  // unacked append
       }
       {
         RaftLog log;
@@ -287,6 +426,49 @@ int main(int argc, char** argv) {
       CHECK(log.last_index() == 1);
       CHECK(log.at(1).data == "a");
     }
+    // 6f. Torn final append whose PAYLOAD embeds a CRC-valid record
+    //     image (adversarial client data). The sidecar claim equals the
+    //     pre-append EOF (the torn append's fsync never returned), the
+    //     length field is plausible, so the follower scan starts past
+    //     the claimed extent — the embedded image is the record's own
+    //     payload and must NOT read as mid-file rot (this wedged the
+    //     node permanently before the ADVICE-r4 fix).
+    {
+      // A genuine framed record image, harvested from a scratch log.
+      std::string img;
+      {
+        RaftLog src;
+        src.open(dir, "imgsrc");
+        src.append(entry(9, "payload"));
+        std::ifstream in(dir + "/imgsrc/log", std::ios::binary);
+        std::string bytes((std::istreambuf_iterator<char>(in)),
+                          std::istreambuf_iterator<char>());
+        img = bytes.substr(12);  // strip the v2 header
+      }
+      std::string d = dir + "/embed";
+      { RaftLog log; log.open(dir, "embed"); fill(log); }
+      {
+        std::ofstream f(d + "/log", std::ios::binary | std::ios::app);
+        raftnative::Buf torn;  // len | junk | IMG | bogus crc
+        torn.u32(static_cast<uint32_t>(4 + img.size() + 4));
+        torn.raw("ABCD");
+        torn.raw(img);
+        torn.raw("WXYZ");  // wrong CRC — the append tore
+        f.write(torn.s.data(),
+                static_cast<std::streamsize>(torn.s.size()));
+      }
+      {
+        RaftLog log;
+        log.open(dir, "embed");  // must RECOVER, not abort
+        CHECK(log.last_index() == 5);
+        CHECK(log.at(5).data == "e");
+        log.append(entry(4, "f"));
+      }
+      RaftLog log;
+      log.open(dir, "embed");
+      CHECK(log.last_index() == 6);
+      CHECK(log.at(6).data == "f");
+    }
     // 7. File truncated mid-record (torn write of the LAST record):
     //    the complete prefix is recovered.
     {
@@ -296,6 +478,9 @@ int main(int argc, char** argv) {
       CHECK(::stat((d + "/log").c_str(), &st) == 0);
       CHECK(::truncate((d + "/log").c_str(),
                        static_cast<off_t>(st.st_size - 3)) == 0);
+      // Torn write ⇒ the last append's sidecar update never happened
+      // (with it intact, the same shape is lost-suffix and fail-stops).
+      CHECK(::unlink((d + "/synced").c_str()) == 0);
       RaftLog log;
       log.open(dir, "torn-mid");
       CHECK(log.last_index() == 4);
@@ -334,6 +519,9 @@ int main(int argc, char** argv) {
       out.write(old_log.data(),
                 static_cast<std::streamsize>(old_log.size()));
       out.close();
+      // In the real crash (between snap-rename and log-rewrite-rename)
+      // the rewrite had already durably dropped the sidecar.
+      ::unlink((d + "/synced").c_str());
       RaftLog log;
       log.open(dir, "stale-prefix");
       CHECK(log.base_index() == 3 && log.base_term() == 2);

@@ -353,7 +353,7 @@ def test_log_selftest_failstop_on_midfile_rot(tmp_path):
          "rotten"],
         capture_output=True, text=True, timeout=30)
     assert out.returncode != 0
-    assert "corrupt mid-file" in out.stderr
+    assert "log record corrupt at byte" in out.stderr
 
 
 def test_log_selftest_failstop_on_body_rot(tmp_path):
@@ -370,4 +370,65 @@ def test_log_selftest_failstop_on_body_rot(tmp_path):
          "rotten-body"],
         capture_output=True, text=True, timeout=30)
     assert out.returncode != 0
-    assert "corrupt mid-file" in out.stderr
+    assert "log record corrupt at byte" in out.stderr
+
+
+def test_log_selftest_failstop_on_final_record_rot(tmp_path):
+    """Rot of the FINAL acked record has no follower to scan for; only
+    the synced-length sidecar distinguishes it from a torn unacked
+    append. With a fresh sidecar it must fail-stop instead of silently
+    truncating an acked entry (ADVICE r4 — previously a silent
+    one-node durable-loss case)."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"),
+         "rot-final"],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode != 0
+    assert "within synced extent" in out.stderr
+
+
+def test_log_selftest_failstop_on_lost_suffix(tmp_path):
+    """A log file shorter than its sidecar's synced claim means acked
+    bytes vanished (external truncation / dying disk): fail-stop, since
+    truncating further would compound the durable loss."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"),
+         "lost-suffix"],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode != 0
+    assert "shorter than its synced-length sidecar" in out.stderr
+
+
+@pytest.mark.parametrize("mode,needle", [
+    ("lost-file", "sidecar claims acked bytes"),
+    ("lost-empty", "shorter than its synced-length sidecar"),
+    ("rot-header", "header corrupt within synced extent"),
+    ("rot-len-overrun", "valid record follows"),
+    ("rot-len-inbounds", "valid record follows"),
+])
+def test_log_selftest_review_findings_failstop(tmp_path, mode, needle):
+    """Round-5 review findings on the sidecar discriminator: total log
+    loss (rm / truncate-to-0) and header rot under a valid sidecar claim
+    fail-stop like partial loss; a mid-file length field rotted to an
+    EOF-overrunning value must not have its claimed extent trusted (the
+    whole-remainder scan finds the intact acked followers)."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"), mode],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode != 0
+    assert needle in out.stderr
