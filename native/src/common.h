@@ -254,19 +254,38 @@ struct MemberSpec {
            std::to_string(peer_port);
   }
 
+  // Strict digits-only port parse. std::stoi here was an abort hole:
+  // its invalid_argument/out_of_range are NOT WireError, so a bad spec
+  // arriving over the PEER plane (E_CONFIG entry, forwarded add-server)
+  // escaped every wire-level handler and std::terminate'd the server
+  // (round-5 peer-fuzz finding). Everything a frame can make parse
+  // throw must be WireError.
+  static int parse_port(const std::string& s) {
+    if (s.empty() || s.size() > 5) throw WireError("bad port: " + s);
+    long v = 0;
+    for (char c : s) {
+      if (c < '0' || c > '9') throw WireError("bad port: " + s);
+      v = v * 10 + (c - '0');
+    }
+    if (v > 65535) throw WireError("bad port: " + s);
+    return static_cast<int>(v);
+  }
+
   static MemberSpec parse(const std::string& spec) {
     MemberSpec m;
     auto eq = spec.find('=');
     if (eq == std::string::npos) throw WireError("bad member spec: " + spec);
     m.name = spec.substr(0, eq);
+    if (m.name.empty())  // maps key members by name; "" would collide
+      throw WireError("bad member spec (empty name): " + spec);
     std::string rest = spec.substr(eq + 1);
     auto c1 = rest.find(':');
     auto c2 = rest.find(':', c1 == std::string::npos ? 0 : c1 + 1);
     if (c1 == std::string::npos || c2 == std::string::npos)
       throw WireError("bad member spec: " + spec);
     m.host = rest.substr(0, c1);
-    m.client_port = std::stoi(rest.substr(c1 + 1, c2 - c1 - 1));
-    m.peer_port = std::stoi(rest.substr(c2 + 1));
+    m.client_port = parse_port(rest.substr(c1 + 1, c2 - c1 - 1));
+    m.peer_port = parse_port(rest.substr(c2 + 1));
     return m;
   }
 };

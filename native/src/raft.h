@@ -19,6 +19,7 @@
 #pragma once
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <functional>
 #include <future>
@@ -68,6 +69,12 @@ class StateMachine {
   // like the election inspector).
   virtual void save(std::ostream&) {}
   virtual void load(std::istream&) {}
+  // Dry-parse a snapshot state payload WITHOUT mutating the machine.
+  // InstallSnapshot calls this before committing to the install: load()
+  // clears state before parsing, so a garbage payload from a confused
+  // peer would otherwise force the post-mutation abort path (round-5
+  // peer-fuzz finding). A stateful SM must override alongside load().
+  virtual bool validate_snapshot(const Bytes&) { return true; }
 };
 
 class RaftNode {
@@ -181,8 +188,25 @@ class RaftNode {
         std::string origin = r.str();
         uint8_t kind = r.u8();
         Bytes payload = r.str();
+        // Bound the detached-thread fan-out: each in-flight forward can
+        // hold a consensus wait for repl_timeout_ms, so an unbounded
+        // storm of P_FWD_REQ frames is a thread/memory exhaustion DoS
+        // (round-5 peer-fuzz hardening). Shedding with a DEFINITE error
+        // is safe — a shed request was never submitted.
+        if (fwd_inflight_.fetch_add(1) >= kMaxFwdInflight) {
+          fwd_inflight_.fetch_sub(1);
+          Buf b;
+          b.u8(wire::P_FWD_RESP);
+          b.u64(reqid);
+          b.u8(0);
+          b.u8(wire::ERR_SERVER);
+          b.str("forward backlog full");
+          tr_->send(origin, b.s);
+          break;
+        }
         std::thread([this, reqid, origin, kind, payload] {
           handle_fwd_req(reqid, origin, kind, payload);
+          fwd_inflight_.fetch_sub(1);
         }).detach();
         break;
       }
@@ -278,7 +302,14 @@ class RaftNode {
                                "a membership change is already in flight");
       std::vector<MemberSpec> next = config_;
       if (add) {
-        MemberSpec m = MemberSpec::parse(payload);
+        MemberSpec m;
+        try {
+          m = MemberSpec::parse(payload);
+        } catch (const WireError& e) {
+          // Reaches here from forwarded peer frames too — answer, don't
+          // throw across the detached forward thread (round-5 fuzz).
+          return Result::error(wire::ERR_SERVER, e.what());
+        }
         for (const auto& c : next)
           if (c.name == m.name)
             return Result::error(wire::ERR_SERVER,
@@ -541,9 +572,32 @@ class RaftNode {
             uint64_t eterm = r.u64();
             uint8_t etype = r.u8();
             Bytes data = r.str();
+            // Boundary validation BEFORE append (round-5 peer-fuzz
+            // finding, same stance as the client plane's canonical
+            // re-encode): an E_CONFIG whose payload does not decode
+            // would otherwise be PERSISTED first and parsed later —
+            // adopt_config here, reconfig_from_log on every restart —
+            // turning one malformed frame from a confused peer into a
+            // crash-looping poison pill. Stop the batch at the bad
+            // entry; match only acks what we actually appended, so a
+            // genuinely confused leader just stalls, never kills us.
+            if (etype == wire::E_CONFIG && !config_decodes(data)) break;
             ++idx;
             if (idx <= log_.last_index()) {
               if (log_.term_at(idx) == eterm) continue;  // already have it
+              // A conflict AT OR BELOW commit_index_ is impossible from
+              // a legitimate leader (Leader Completeness: every leader's
+              // log contains all committed entries) — honoring it would
+              // truncate committed entries out from under the applier,
+              // which indexes the log up to commit_index_ (round-5
+              // peer-fuzz finding: prev=(0,0) always passes the prev
+              // check, so one hostile frame reached this with idx=1).
+              // Reject the rest of the RPC instead; a real leader never
+              // sees this failure.
+              if (idx <= commit_index_) {
+                success = false;
+                break;
+              }
               log_.truncate_from(idx);
               reconfig_from_log_locked();
             }
@@ -620,17 +674,25 @@ class RaftNode {
         if (term > my_term || role_ != Role::Follower) step_down_locked(term);
         leader_hint_ = leader;
         reset_election_deadline();
-        if (bidx > commit_index_) {
+        // Pre-validate BOTH payloads before mutating anything (round-5
+        // peer-fuzz finding): load() clears the SM before parsing and
+        // install_snapshot rewrites the log, so parse failures after
+        // the point of no return could only abort. A snapshot that
+        // fails the dry parse is rejected un-acked (match stays 0) —
+        // a real leader's snapshot always validates, a confused peer's
+        // garbage must not kill the follower.
+        bool valid = bidx <= commit_index_ ||
+                     (sm_->validate_snapshot(state) && config_decodes(config));
+        if (valid && bidx > commit_index_) {
           // Adopt: the snapshot covers strictly more than we have
           // committed, so nothing it replaces can conflict with a
           // commitment of ours. The log keeps any suffix that matches
           // the snapshot's last included (index, term) — Raft Fig. 13
-          // rule 6, see log.h install_snapshot. FAIL-STOP on a corrupt
-          // state payload: the log is already mutated by the time load
-          // throws, so continuing would leave base_index_ ahead of a
-          // half-cleared state machine (and the applier indexing past
-          // an empty entries_ vector) — same stance as persistence
-          // failure in log.h.
+          // rule 6, see log.h install_snapshot. FAIL-STOP if install
+          // still throws past validation: the log is already mutated,
+          // so continuing would leave base_index_ ahead of a
+          // half-cleared state machine — and reaching here past the
+          // dry parse means the bug is ours, not the peer's.
           try {
             log_.install_snapshot(bidx, bterm, state, config);
             std::istringstream in(state);
@@ -649,7 +711,7 @@ class RaftNode {
         // Committed prefixes agree, so claiming bidx is safe even when we
         // were already past it (the leader just advances next_index and
         // verifies everything above it with ordinary AppendEntries).
-        match = bidx;
+        if (valid) match = bidx;
       }
       resp.u8(wire::P_SNAP_RESP);
       resp.u64(log_.current_term());
@@ -762,6 +824,20 @@ class RaftNode {
     return out;
   }
 
+  // Dry-parse guard for config payloads arriving over the peer plane —
+  // both E_CONFIG entries (append path) and snapshot configs must be
+  // proven decodable BEFORE they are persisted or adopted (round-5
+  // peer-fuzz finding: a persisted undecodable config crash-looped the
+  // node through reconfig_from_log on every restart).
+  static bool config_decodes(const Bytes& data) {
+    try {
+      return !decode_config(data).empty();  // empty config can never be
+                                            // valid: it has no quorum
+    } catch (const std::exception&) {
+      return false;
+    }
+  }
+
   // Config takes effect at APPEND time (single-server change discipline).
   void adopt_config(const Bytes& data) {
     config_ = decode_config(data);
@@ -843,7 +919,17 @@ class RaftNode {
                       const Bytes& payload) {
     // leader_execute re-checks leadership itself and answers NOT_LEADER if
     // the hint was stale — it never re-forwards, so hint chains cannot loop.
-    Result res = leader_execute(static_cast<FwdKind>(kind), payload);
+    // This runs on a detached thread with NO enclosing handler: any
+    // exception here is std::terminate for the whole server, so peer-
+    // supplied payloads (e.g. a malformed add-server member spec) must
+    // come back as error responses, never escape (round-5 peer fuzz).
+    Result res;
+    try {
+      res = leader_execute(static_cast<FwdKind>(kind), payload);
+    } catch (const std::exception& e) {
+      res = Result::error(wire::ERR_SERVER,
+                          std::string("forward failed: ") + e.what());
+    }
     Buf b;
     b.u8(wire::P_FWD_RESP);
     b.u64(reqid);
@@ -901,6 +987,8 @@ class RaftNode {
   std::mutex fwd_mu_;
   uint64_t next_fwd_id_ = 1;
   std::map<uint64_t, std::shared_ptr<std::promise<Result>>> fwd_pending_;
+  static constexpr int kMaxFwdInflight = 256;
+  std::atomic<int> fwd_inflight_{0};
 
   std::condition_variable apply_cv_;
   std::atomic<bool> running_{false};

@@ -152,6 +152,23 @@ class MapStateMachine : public StateMachine {
     }
   }
 
+  // Dry parse mirroring load() — InstallSnapshot rejects garbage from a
+  // confused peer BEFORE load() clears the live map (round-5 fuzz).
+  bool validate_snapshot(const Bytes& state) override {
+    if (state.empty()) return true;
+    try {
+      Reader r(state);
+      uint32_t n = r.u32();
+      for (uint32_t i = 0; i < n; ++i) {
+        r.u64();
+        r.i64();
+      }
+      return r.done();  // trailing garbage = not ours
+    } catch (const WireError&) {
+      return false;
+    }
+  }
+
  private:
   Bytes encode_get(uint64_t key) {
     Buf b;
@@ -280,6 +297,22 @@ class CounterStateMachine : public StateMachine {
     for (uint32_t i = 0; i < n; ++i) {
       std::string name = r.str();
       counters_[name] = r.i64();
+    }
+  }
+
+  // Dry parse mirroring load() — see MapStateMachine::validate_snapshot.
+  bool validate_snapshot(const Bytes& state) override {
+    if (state.empty()) return true;
+    try {
+      Reader r(state);
+      uint32_t n = r.u32();
+      for (uint32_t i = 0; i < n; ++i) {
+        r.str();
+        r.i64();
+      }
+      return r.done();
+    } catch (const WireError&) {
+      return false;
     }
   }
 
