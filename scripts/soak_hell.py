@@ -114,7 +114,47 @@ def one_run(i: int, args, workload: str, n: int, workdir: Path) -> dict:
         "ok_ops": sum(1 for op in test["history"] if op.type == "ok"),
         "info_ops": sum(1 for op in test["history"] if op.type == "info"),
         "store_dir": test["store_dir"],
+        "pressure": _pressure(wl),
     }
+
+
+def _pressure(wl: dict) -> dict:
+    """Checker-pressure profile of one run (VERDICT r4 #4): how many
+    per-key checks ran, which engine/kernel decided them, the
+    concurrency-window distribution, and total checking time — the
+    shape data that says what a canonical-envelope run actually asks
+    of the linearizability ladder."""
+    lin = wl.get("linear", {})
+    interval = lin.get("checker") == "counter-interval"
+    if interval:
+        # Decided at the bounds tier AFTER the exact engines burned
+        # their budgets — profile the exact attempt (it is the most
+        # expensive part of exactly these runs) and mark the tier.
+        lin = lin.get("exact", {})
+    per_key = lin.get("results")
+    rows = (list(per_key.values()) if isinstance(per_key, dict)
+            else [lin] if lin.get("algorithm") else [])
+    windows: dict = {}
+    engines: dict = {}
+    ops = 0
+    t = 0.0
+    for r in rows:
+        if not isinstance(r, dict):
+            continue
+        w = r.get("concurrency-window")
+        if w is not None:
+            windows[str(w)] = windows.get(str(w), 0) + 1
+        eng = r.get("kernel") or r.get("algorithm")
+        if eng:
+            engines[eng] = engines.get(eng, 0) + 1
+        ops += int(r.get("op-count") or 0)
+        t += float(r.get("time-s") or 0.0)
+    if interval:
+        engines["interval"] = 1  # the tier that actually decided
+    return {"keys": len(rows), "checked_ops": ops,
+            "check_time_s": round(t, 2),
+            "windows": dict(sorted(windows.items(), key=lambda kv: int(kv[0]))),
+            "engines": engines}
 
 
 def main(argv=None) -> int:
@@ -154,8 +194,14 @@ def main(argv=None) -> int:
         else:
             status = "unknown/error"
             (failures if r.get("error") else unknowns).append(r)
+        pr = r.get("pressure") or {}
         print(f"  run {i + 1}/{args.runs} seed={r['seed']} "
               f"{workload}: {status}"
+              + (f" ok={r.get('ok_ops')} info={r.get('info_ops')} "
+                 f"keys={pr.get('keys')} windows={pr.get('windows')} "
+                 f"engines={pr.get('engines')} "
+                 f"check_s={pr.get('check_time_s')}"
+                 if "ok_ops" in r else "")
               + (f" (kept {r['store_dir']})" if keep else ""), flush=True)
 
     dt = time.perf_counter() - t0
