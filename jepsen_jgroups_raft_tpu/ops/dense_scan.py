@@ -42,6 +42,7 @@ ids transition identically; the search just mirrors them).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -232,6 +233,25 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
     return groups, rest
 
 
+def scan_unroll() -> int:
+    """Events per lax.scan step across the event-scan kernels (dense,
+    mask, segment, sort) — an ablation knob for the on-chip sweep
+    (scripts/calibrate_routing.py --unroll), JGRAFT_SCAN_UNROLL to
+    override. Default 1 EVERYWHERE: CPU-mesh measurements did not
+    survive re-measurement through the production path (a hand-built
+    kernel probe showed unroll=2 at 1.49× on a B=4 × 15.7k-event
+    launch, but the same shape through the bucketed production kernels
+    measured unroll=1 faster, 11.2 s vs 16.0 s — the round-3 lesson
+    about one-probe conclusions, again). Whether unroll buys anything
+    on the v5e scan (where per-step loop overhead, not FLOPs, is the
+    suspected wall) is exactly what the on-chip sweep answers.
+    Resolved at kernel-build time and part of the kernel-cache key."""
+    v = os.environ.get("JGRAFT_SCAN_UNROLL")
+    if v:
+        return max(1, int(v))
+    return 1
+
+
 def _bit_table(M: int, W: int) -> np.ndarray:
     """[M, W] static table: bit w of mask m."""
     return (np.arange(M)[:, None] >> np.arange(W)[None, :]) & 1
@@ -347,7 +367,8 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False), val_of,
         )
-        carry, _ = lax.scan(scan_step, carry, events)
+        carry, _ = lax.scan(scan_step, carry, events,
+                            unroll=scan_unroll())
         # The dense frontier cannot overflow: the array is the whole
         # configuration space. Second output mirrors the sort kernel's
         # (valid, overflow) contract.
@@ -441,7 +462,8 @@ def make_mask_dense_history_checker(model, n_slots: int):
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False),
         )
-        carry, _ = lax.scan(scan_step, carry, events)
+        carry, _ = lax.scan(scan_step, carry, events,
+                            unroll=scan_unroll())
         return carry[8], jnp.bool_(False)
 
     return check
@@ -461,7 +483,11 @@ _KERNEL_CACHE: dict = {}
 def make_dense_batch_checker(model, kind: str, n_slots: int, n_states: int,
                              jit: bool = True):
     """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B])."""
-    key = (*model.cache_key(), kind, int(n_slots), int(n_states), jit)
+    # scan_unroll() keys the cache: the build closures resolve it at
+    # trace time, so an env/backend change mid-process (ablation sweeps,
+    # CPU degrade) must map to a distinct compiled kernel.
+    key = (*model.cache_key(), kind, int(n_slots), int(n_states), jit,
+           scan_unroll())
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         single = make_dense_single_checker(model, kind, n_slots, n_states)

@@ -63,6 +63,7 @@ import numpy as np
 from jax import lax
 
 from ..history.packing import EV_FORCE, EV_OPEN
+from .dense_scan import scan_unroll
 
 #: Hard window cap (4 mask words). Histories needing more concurrent slots
 #: (incl. never-retiring info ops) fall back to the CPU checker, whose
@@ -252,7 +253,8 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False), jnp.bool_(False),
         )
-        carry, _ = lax.scan(scan_step, carry, events)
+        carry, _ = lax.scan(scan_step, carry, events,
+                            unroll=scan_unroll())
         ok, overflow = carry[6], carry[7]
         # An overflowed run may have dropped configurations: a "False" can
         # be a false negative, so report unknown instead (caller escalates).
@@ -283,7 +285,11 @@ def make_batch_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
     function object, so handing it a fresh closure per call would recompile
     every time. Model identity = `Model.cache_key()`.
     """
-    key = (*model.cache_key(), int(n_configs), int(n_slots), jit)
+    # scan_unroll() keys the cache (same invariant as dense_scan's):
+    # the build closure resolves it at trace time, so an env change
+    # mid-process must map to a distinct compiled kernel.
+    key = (*model.cache_key(), int(n_configs), int(n_slots), jit,
+           scan_unroll())
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         single = make_history_checker(model, n_configs, n_slots)

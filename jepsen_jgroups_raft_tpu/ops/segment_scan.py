@@ -57,7 +57,7 @@ from jax import lax
 from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
 from .dense_scan import (DENSE_MAX_CELLS, DENSE_MAX_SLOTS, DENSE_MAX_STATES,
                          _bit_table, _closure_fixpoint, _make_force_branches,
-                         _pad_domains)
+                         _pad_domains, scan_unroll)
 
 #: Segment the stream only when it is long enough to be worth the basis
 #: overhead; shorter histories take the plain dense kernel.
@@ -245,7 +245,8 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
             jnp.bool_(False), val_of,
         )
-        carry, _ = lax.scan(scan_step, carry, events)
+        carry, _ = lax.scan(scan_step, carry, events,
+                            unroll=scan_unroll())
         return carry[0]
 
     over_basis = jax.vmap(run_one, in_axes=(None, None, 0, 0))
@@ -257,7 +258,8 @@ _SEG_KERNEL_CACHE: dict = {}
 
 
 def _segment_kernel(model, W: int, S: int, E: int):
-    key = (*model.cache_key(), W, S, E)
+    # scan_unroll() in the key: see dense_scan.make_dense_batch_checker.
+    key = (*model.cache_key(), W, S, E, scan_unroll())
     fn = _SEG_KERNEL_CACHE.get(key)
     if fn is None:
         fn = make_segment_kernel(model, W, S, E)

@@ -38,6 +38,12 @@ def main(argv=None) -> int:
                     help="4 shapes only (CI smoke)")
     ap.add_argument("--repeats", type=int, default=3,
                     help="timed repetitions per shape (min is kept)")
+    ap.add_argument("--unroll", action="store_true",
+                    help="also sweep JGRAFT_SCAN_UNROLL in {1,2,4} per "
+                         "shape on the default backend (round-5: unroll=2 "
+                         "measured 1.49x on the CPU mesh at the config-4 "
+                         "shape; the TPU default stays 1 until this sweep "
+                         "runs on-chip)")
     args = ap.parse_args(argv)
 
     import jax
@@ -103,6 +109,30 @@ def main(argv=None) -> int:
                      "default_s": round(t_default, 4),
                      "host_s": round(t_host, 4),
                      "host_wins": bool(t_host < t_default)})
+        if args.unroll:
+            import os
+            sweep = {}
+            try:
+                for u in (1, 2, 4):
+                    os.environ["JGRAFT_SCAN_UNROLL"] = str(u)
+                    # The kernel cache keys on scan_unroll(), so this
+                    # builds (and compiles) a distinct kernel per value.
+                    k_u = make_dense_batch_checker(
+                        CasRegister(), plan.kind, plan.n_slots,
+                        plan.n_states)
+                    np.asarray(k_u(ev, val_of)[0])
+                    best = float("inf")
+                    for _ in range(args.repeats):
+                        t0 = time.perf_counter()
+                        np.asarray(k_u(ev, val_of)[0])
+                        best = min(best, time.perf_counter() - t0)
+                    sweep[f"unroll{u}"] = round(best, 4)
+            finally:
+                # A compile failure mid-sweep must not leak the unroll
+                # into later shapes' default timings (they'd be
+                # mislabeled and poison the derived gate).
+                os.environ.pop("JGRAFT_SCAN_UNROLL", None)
+            rows[-1]["unroll_sweep"] = sweep
         print(json.dumps(rows[-1]), flush=True)
 
     # Derive the gate from the FIRST crossover in cell order, not the
