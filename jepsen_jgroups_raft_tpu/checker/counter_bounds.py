@@ -139,6 +139,22 @@ def interval_check(history: History) -> dict:
             "reads-checked": reads, "final-range": [lo, hi]}
 
 
+def decide_unknown_with_interval(exact_result: dict,
+                                 history: History) -> dict:
+    """Decide an exact-UNKNOWN counter verdict at the interval tier,
+    labeling the weaker certificate and attaching the exhausted exact
+    attempt. Shared by the live workload checker (CounterChecker) and
+    the recorded-store re-check path so the two produce the same
+    results.json shape."""
+    b = interval_check(history)
+    b["certificate"] = "interval"
+    b["exact"] = {k: v for k, v in exact_result.items() if k != "valid?"}
+    b["note"] = ("exact engines exhausted (window beyond budget); "
+                 "verdict decided at jepsen checker/counter interval "
+                 "semantics")
+    return b
+
+
 class CounterChecker(Checker):
     """Exact linearizability first; the interval tier decides UNKNOWNs.
 
@@ -158,10 +174,4 @@ class CounterChecker(Checker):
             return r
         if not isinstance(history, History):
             history = History(history)
-        b = interval_check(history.client_ops())
-        b["certificate"] = "interval"
-        b["exact"] = {k: v for k, v in r.items() if k != "valid?"}
-        b["note"] = ("exact engines exhausted (window beyond budget); "
-                     "verdict decided at jepsen checker/counter interval "
-                     "semantics")
-        return b
+        return decide_unknown_with_interval(r, history.client_ops())

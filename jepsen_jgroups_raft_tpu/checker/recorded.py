@@ -109,6 +109,16 @@ def check_recorded(run_dirs: Sequence, workload: Optional[str] = None,
         else:
             results = check_histories(hists, model, algorithm=algorithm,
                                       n_configs=n_configs)
+            if isinstance(model, Counter):
+                # Same tier ladder as the live counter workload
+                # (workload/counter.py CounterChecker): a recorded
+                # canonical-envelope counter run must not re-check to
+                # UNKNOWN when the bounds tier can decide it.
+                from .counter_bounds import decide_unknown_with_interval
+                for j, r in enumerate(results):
+                    if r.get("valid?") is UNKNOWN:
+                        results[j] = decide_unknown_with_interval(
+                            r, hists[j])
         for (d, _), r in zip(tagged, results):
             per_run[d].append(r)
     dt = time.perf_counter() - t0

@@ -30,7 +30,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..history.packing import pad_batch_bucketed
-from ..ops.dense_scan import make_dense_single_checker
+from ..ops.dense_scan import make_dense_single_checker, scan_unroll
 from ..ops.linear_scan import DEFAULT_N_CONFIGS, MAX_SLOTS, make_history_checker
 
 BATCH_AXIS = "data"
@@ -64,8 +64,10 @@ def sharded_batch_checker(model, mesh: Mesh,
     `real` masks padding rows out of the aggregates — EV_PAD histories are
     trivially valid, so counting them would silently inflate n_valid.
     """
+    # scan_unroll() in the key: the wrapped kernel bakes it in at trace
+    # time (same invariant as every ops/ kernel cache).
     key = (*model.cache_key(), int(n_configs), int(n_slots),
-           tuple(mesh.devices.flat), axis_name)
+           tuple(mesh.devices.flat), axis_name, scan_unroll())
     fn = _CACHE.get(key)
     if fn is not None:
         return fn
@@ -102,7 +104,8 @@ def sharded_dense_checker(model, mesh: Mesh, kind: str, n_slots: int,
     domain table (or the mask-mode dummy) and the padding mask shard with
     the batch."""
     key = ("dense", kind, *model.cache_key(), int(n_slots),
-           int(n_states), tuple(mesh.devices.flat), axis_name)
+           int(n_states), tuple(mesh.devices.flat), axis_name,
+           scan_unroll())
     fn = _CACHE.get(key)
     if fn is not None:
         return fn

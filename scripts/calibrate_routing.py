@@ -112,6 +112,7 @@ def main(argv=None) -> int:
         if args.unroll:
             import os
             sweep = {}
+            prior = os.environ.get("JGRAFT_SCAN_UNROLL")
             try:
                 for u in (1, 2, 4):
                     os.environ["JGRAFT_SCAN_UNROLL"] = str(u)
@@ -128,10 +129,14 @@ def main(argv=None) -> int:
                         best = min(best, time.perf_counter() - t0)
                     sweep[f"unroll{u}"] = round(best, 4)
             finally:
-                # A compile failure mid-sweep must not leak the unroll
-                # into later shapes' default timings (they'd be
-                # mislabeled and poison the derived gate).
-                os.environ.pop("JGRAFT_SCAN_UNROLL", None)
+                # Restore (not pop) so neither a mid-sweep failure nor
+                # an operator-set value leaks a DIFFERENT unroll into
+                # later shapes' default timings (mislabeled rows would
+                # poison the derived gate).
+                if prior is None:
+                    os.environ.pop("JGRAFT_SCAN_UNROLL", None)
+                else:
+                    os.environ["JGRAFT_SCAN_UNROLL"] = prior
             rows[-1]["unroll_sweep"] = sweep
         print(json.dumps(rows[-1]), flush=True)
 

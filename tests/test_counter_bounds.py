@@ -131,3 +131,35 @@ def test_wrapper_decides_unknown_at_interval_tier():
     r = CounterChecker(_StubUnknown()).check({}, bad)
     assert r["valid?"] is False
     assert r["certificate"] == "interval"
+
+
+def test_recorded_counter_unknowns_decided_at_interval_tier(monkeypatch,
+                                                            tmp_path):
+    """The recorded-store re-check path (cli `check`) carries the same
+    tier ladder as the live counter workload: exact-UNKNOWN counter
+    histories are decided by the bounds tier, not reported unknown."""
+    import json
+
+    from jepsen_jgroups_raft_tpu.checker import recorded
+
+    store = tmp_path / "run"
+    store.mkdir()
+    hist = [
+        {"process": 0, "type": "invoke", "f": "add", "value": 3},
+        {"process": 0, "type": "ok", "f": "add", "value": 3},
+        {"process": 1, "type": "invoke", "f": "read", "value": None},
+        {"process": 1, "type": "ok", "f": "read", "value": 3},
+    ]
+    (store / "history.jsonl").write_text(
+        "\n".join(json.dumps(op) for op in hist))
+    (store / "test.json").write_text(json.dumps({"workload": "counter"}))
+
+    monkeypatch.setattr(
+        recorded, "check_histories",
+        lambda hists, model, **kw: [{"valid?": UNKNOWN,
+                                     "error": "budget"}] * len(hists))
+    summary = recorded.check_recorded([store])
+    assert summary["valid?"] is True
+    assert summary["n-unknown"] == 0
+    [verdict] = summary["run-verdicts"].values()
+    assert verdict is True
