@@ -44,19 +44,29 @@ class Transport {
     accept_thread_ = std::thread([this] { accept_loop(); });
   }
 
+  // Stop is a full QUIESCE: when it returns, no transport thread can
+  // touch handler_ (or anything the handler closes over) ever again —
+  // the contract an embedder needs to destroy the consensus object
+  // behind the handler and restart in place (round-5 TSAN finding via
+  // the peer-fuzz restart mode: inbound reader threads are detached,
+  // so without the drain they could call a freed RaftNode).
   void stop() {
     running_ = false;
-    if (listen_fd_ >= 0) {
-      ::shutdown(listen_fd_, SHUT_RDWR);
-      ::close(listen_fd_);
-      listen_fd_ = -1;
+    int lfd = listen_fd_.exchange(-1);
+    if (lfd >= 0) {
+      ::shutdown(lfd, SHUT_RDWR);
+      ::close(lfd);
     }
     {
       std::lock_guard<std::mutex> g(mu_);
       for (auto& kv : links_) kv.second->stop();
       links_.clear();
+      // Wake readers blocked in recv; each unregisters itself on exit.
+      for (int fd : inbound_) ::shutdown(fd, SHUT_RDWR);
     }
     if (accept_thread_.joinable()) accept_thread_.join();
+    std::unique_lock<std::mutex> g(mu_);
+    drained_cv_.wait(g, [this] { return inbound_.empty(); });
   }
 
   ~Transport() {
@@ -67,6 +77,11 @@ class Transport {
                    int port) {
     if (name == self_) return;
     std::lock_guard<std::mutex> g(mu_);
+    // A consensus object still running while its transport stops (the
+    // teardown window) must not resurrect Links into the cleared map —
+    // their detached sender threads would never be told to stop
+    // (round-5 review).
+    if (!running_) return;
     auto it = links_.find(name);
     if (it != links_.end()) {
       if (it->second->host == host && it->second->port == port) return;
@@ -91,12 +106,13 @@ class Transport {
     }
   }
 
-  // Enqueue a frame for a peer; silently dropped if unknown or blocked.
+  // Enqueue a frame for a peer; silently dropped if unknown, blocked,
+  // or the transport is stopped/stopping.
   void send(const std::string& peer, Bytes payload) {
     std::shared_ptr<Link> link;
     {
       std::lock_guard<std::mutex> g(mu_);
-      if (blocked_.count(peer)) return;
+      if (!running_ || blocked_.count(peer)) return;
       auto it = links_.find(peer);
       if (it == links_.end()) return;
       link = it->second;
@@ -215,10 +231,22 @@ class Transport {
 
   void accept_loop() {
     while (running_) {
-      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      int lfd = listen_fd_.load();
+      if (lfd < 0) break;
+      int cfd = ::accept(lfd, nullptr, nullptr);
       if (cfd < 0) {
         if (!running_) break;
         continue;
+      }
+      {
+        // Register BEFORE spawning so stop() can always reach the fd;
+        // a stop racing the accept closes it here instead.
+        std::lock_guard<std::mutex> g(mu_);
+        if (!running_) {
+          ::close(cfd);
+          break;
+        }
+        inbound_.insert(cfd);
       }
       std::thread([this, cfd] { reader_loop(cfd); }).detach();
     }
@@ -245,16 +273,29 @@ class Transport {
     } catch (const WireError&) {
       // connection died; peer reconnects
     }
+    {
+      // Unregister BEFORE closing: close-then-erase would let the
+      // kernel recycle the fd number into a concurrent accept whose
+      // registration this erase would then delete — stop()'s drain
+      // would miss that live reader (round-5 review). After the erase
+      // this thread touches nothing shared; the trailing close only
+      // affects an fd no other thread can own until it happens.
+      std::lock_guard<std::mutex> g(mu_);
+      inbound_.erase(cfd);
+      drained_cv_.notify_all();  // stop() may be waiting for the drain
+    }
     ::close(cfd);
   }
 
   std::string self_;
   Handler handler_;
   std::atomic<bool> running_{false};
-  int listen_fd_ = -1;
+  std::atomic<int> listen_fd_{-1};
   std::thread accept_thread_;
   std::mutex mu_;
+  std::condition_variable drained_cv_;
   std::map<std::string, std::shared_ptr<Link>> links_;
+  std::set<int> inbound_;  // live inbound reader fds (drained by stop)
   std::set<std::string> blocked_;
 };
 

@@ -74,14 +74,41 @@ int free_port() {
 }
 
 struct Node {
-  MapStateMachine sm;
+  // The SM is recreated with the RaftNode on restart: a real restart is
+  // a fresh process, and replaying the log into a stale in-memory map
+  // would double-apply (CAS results would diverge from the replicas).
+  std::unique_ptr<MapStateMachine> sm;
   Transport tr;
   std::unique_ptr<RaftNode> raft;
 };
 
 struct Cluster {
   std::vector<MemberSpec> members;
+  std::string log_root;  // non-empty → persistent logs (restart mode)
   Node nodes[3];
+
+  RaftNode::Options options(int i) const {
+    RaftNode::Options opt;
+    opt.name = members[i].name;
+    opt.log_dir = log_root;  // "" = ephemeral (plain fuzz mode)
+    opt.election_ms = 150;
+    opt.heartbeat_ms = 50;
+    opt.repl_timeout_ms = 3000;
+    opt.compact_threshold = 16;  // keep snapshot paths under fire
+    opt.initial_members = members;
+    return opt;
+  }
+
+  void start_node(int i) {
+    Node& n = nodes[i];
+    n.sm = std::make_unique<MapStateMachine>();
+    n.raft = std::make_unique<RaftNode>(options(i), n.sm.get(), &n.tr);
+    n.tr.start(members[i].name, "127.0.0.1", members[i].peer_port,
+               [&n](const std::string& s, uint8_t t, Reader& r) {
+                 n.raft->on_peer_msg(s, t, r);
+               });
+    n.raft->start();
+  }
 
   void start() {
     for (int i = 0; i < 3; ++i) {
@@ -92,28 +119,31 @@ struct Cluster {
       m.peer_port = free_port();
       members.push_back(m);
     }
-    for (int i = 0; i < 3; ++i) {
-      RaftNode::Options opt;
-      opt.name = members[i].name;
-      opt.election_ms = 150;
-      opt.heartbeat_ms = 50;
-      opt.repl_timeout_ms = 3000;
-      opt.compact_threshold = 16;  // keep snapshot paths under fire
-      opt.initial_members = members;
-      Node& n = nodes[i];
-      n.raft = std::make_unique<RaftNode>(opt, &n.sm, &n.tr);
-      n.tr.start(members[i].name, "127.0.0.1", members[i].peer_port,
-                 [&n](const std::string& s, uint8_t t, Reader& r) {
-                   n.raft->on_peer_msg(s, t, r);
-                 });
-      n.raft->start();
-    }
+    for (int i = 0; i < 3; ++i) start_node(i);
+  }
+
+  // Crash-recovery under fire: tear the node down (transport included —
+  // a reader thread must never race the RaftNode swap) and bring it
+  // back on the same spec. With log_root set this drives the real
+  // log.h recovery path (v2 CRC records, synced-length sidecar) and —
+  // post-compaction — InstallSnapshot catch-up, all while the fuzz
+  // storm continues against the other nodes.
+  void restart_node(int i) {
+    Node& n = nodes[i];
+    n.tr.stop();
+    n.raft->stop();
+    n.raft.reset();
+    start_node(i);
   }
 
   void stop() {
+    // Transports first (same order as restart_node): a reader that
+    // already passed the raft running_ check must finish before the
+    // raft object's drains run, or a late P_FWD_REQ thread could touch
+    // a stopping node (round-5 review).
+    for (auto& n : nodes) n.tr.stop();
     for (auto& n : nodes)
       if (n.raft) n.raft->stop();
-    for (auto& n : nodes) n.tr.stop();
   }
 
   // End-to-end liveness: PUT key=val through consensus via ANY node
@@ -340,14 +370,21 @@ int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
   uint32_t seed = argc > 1 ? static_cast<uint32_t>(std::atoi(argv[1])) : 1;
   int volleys = argc > 2 ? std::atoi(argv[2]) : 12;
+  // argv[3]: log directory → RESTART MODE: persistent logs, and one
+  // node crash-recovers per volley while the storm continues — the
+  // log.h recovery path (CRC records + synced-length sidecar) and
+  // InstallSnapshot catch-up under hostile traffic.
+  std::string log_root = argc > 3 ? argv[3] : "";
   std::mt19937 rng(seed);
 
   Cluster cluster;
+  cluster.log_root = log_root;
   cluster.start();
   cluster.probe(1, 100);  // up and serving before any fuzz
 
   uint64_t key = 2;
   for (int v = 0; v < volleys; ++v) {
+    if (!log_root.empty()) cluster.restart_node(v % 3);
     for (int node = 0; node < 3; ++node) {
       int port = cluster.members[node].peer_port;
       // 1: honest-fake sender; 2: IMPERSONATE a real member (passes any

@@ -126,6 +126,23 @@ class RaftNode {
     apply_cv_.notify_all();
     if (ticker_.joinable()) ticker_.join();
     if (applier_.joinable()) applier_.join();
+    // Detached forward-handler threads can sit in a consensus wait up
+    // to repl_timeout_ms and then touch this object (and the
+    // transport); an embedder that destroys the node after stop()
+    // needs them gone (round-5 TSAN finding via the peer-fuzz restart
+    // mode). Fail the waits so the drain is prompt, then spin the
+    // in-flight counter down.
+    while (true) {
+      {
+        // Swept each iteration: a forward thread that was entering
+        // submit_local during the previous sweep appends (and waits)
+        // after it — the next sweep releases that wait too.
+        std::lock_guard<std::mutex> g(mu_);
+        fail_pending_locked("node stopping");
+      }
+      if (fwd_inflight_.load() == 0) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
   }
 
   ~RaftNode() {

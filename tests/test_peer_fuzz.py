@@ -37,3 +37,15 @@ def test_peer_fuzz_cluster_survives_and_serves(seed):
         capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "PEER_FUZZ_PASS" in out.stdout
+
+
+def test_peer_fuzz_with_crash_recovery(tmp_path):
+    """Restart mode: one node crash-recovers per volley (persistent
+    logs → the CRC/sidecar recovery path and InstallSnapshot catch-up)
+    while the hostile storm continues."""
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "peer_fuzz"), "11", "4", str(tmp_path / "logs")],
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "PEER_FUZZ_PASS" in out.stdout
