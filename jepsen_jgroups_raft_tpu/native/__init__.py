@@ -27,6 +27,16 @@ MEMBER_CLI = BUILD_DIR / "raft_member_cli"
 _build_lock = threading.Lock()
 _built = False
 
+#: Sanitizer report markers per SAN= build, shared by every scanner
+#: (tests/test_tsan.py, scripts/soak_hell.py --san) so they cannot
+#: drift. No LeakSanitizer marker: every SUT exit under the harness is
+#: SIGKILL, so LSAN's atexit check never runs — listing it would claim
+#: coverage that doesn't exist.
+SAN_MARKERS = {
+    "tsan": ("WARNING: ThreadSanitizer",),
+    "asan": ("ERROR: AddressSanitizer",),
+}
+
 
 def _sources_mtime() -> float:
     src = NATIVE_DIR / "src"

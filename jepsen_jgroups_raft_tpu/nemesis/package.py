@@ -123,8 +123,13 @@ def member_package(opts: dict, db, rng: random.Random) -> Package:
         nemesis=MemberNemesis(db, seed=rng.randrange(2**31)),
         generator=gen,
         # membership.clj:142-157: grow until full again (time-bounded by
-        # the caller's final-phase budget).
-        final_generator=GrowUntilFull(),
+        # the caller's final-phase budget). PACED: a grow that fails
+        # instantly (no alive member mid-heal, stalled SUT) would
+        # otherwise spin the final phase into an unbounded info-op spray
+        # — a starved TSAN soak recorded 101k grow attempts in one run
+        # (round-5 finding); retrying ~4×/s heals just as fast and
+        # bounds the history.
+        final_generator=Delay(0.25, GrowUntilFull()),
         perf=[{"name": "member", "start": {"shrink"}, "stop": {"grow"},
                "color": "#3C8031"}],
     )

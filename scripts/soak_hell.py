@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import shutil
 import sys
 import tempfile
@@ -68,7 +69,31 @@ def parse_args(argv=None):
     p.add_argument("--keep-stores", action="store_true",
                    help="keep every run's store dir (default: only "
                         "failures are kept)")
+    p.add_argument("--san", choices=["tsan", "asan"], default=None,
+                   help="run the SUT under a sanitizer build "
+                        "(native/build-<san>/raft_server): the full "
+                        "stack with real faults becomes the race/memory "
+                        "detector's workload. Expect 5-15x SUT slowdown; "
+                        "size --runs/--time-limit accordingly")
     return p.parse_args(argv)
+
+
+def scan_sanitizer_logs(cluster, nodes, san: str) -> int:
+    """Count sanitizer reports in the SUT node logs (markers shared
+    with tests/test_tsan.py via native.SAN_MARKERS). Called on BOTH the
+    success and exception paths: a wedged run under --san is the most
+    likely place for a race report to be waiting."""
+    from jepsen_jgroups_raft_tpu.native import SAN_MARKERS
+
+    hits = 0
+    for node in nodes:
+        try:
+            text = Path(cluster.log_path(node)).read_text(errors="ignore")
+        except OSError:
+            continue
+        for marker in SAN_MARKERS[san]:
+            hits += text.count(marker)
+    return hits
 
 
 def one_run(i: int, args, workload: str, n: int, workdir: Path) -> dict:
@@ -79,11 +104,17 @@ def one_run(i: int, args, workload: str, n: int, workdir: Path) -> dict:
 
     seed = args.seed + i
     nodes = [f"n{k}" for k in range(1, n + 1)]
+    server_bin = None
+    if args.san:
+        from jepsen_jgroups_raft_tpu.native import NATIVE_DIR, ensure_built
+        ensure_built(args.san)
+        server_bin = str(NATIVE_DIR / f"build-{args.san}" / "raft_server")
     cluster = LocalCluster(nodes, sm=WORKLOAD_SM[workload],
                            workdir=str(workdir / "sut"),
                            election_ms=150, heartbeat_ms=50,
                            repl_timeout_ms=3000,
-                           compact_every=args.compact_every)
+                           compact_every=args.compact_every,
+                           server_bin=server_bin)
     opts = {
         "name": f"soak-hell-{i}", "nodes": nodes,
         "workload": workload, "nemesis": args.nemesis,
@@ -100,10 +131,22 @@ def one_run(i: int, args, workload: str, n: int, workdir: Path) -> dict:
         opts["views_probe"] = cluster.views_probe
     test = compose_test(opts, db=LocalRaftDB(cluster, seed=seed),
                         net=BlockNet(cluster), seed=seed)
+    err = None
     try:
         test = run_test(test)
+    except Exception as e:  # noqa: BLE001 — a wedged run is a finding
+        err = f"{type(e).__name__}: {e}"
     finally:
         cluster.shutdown()
+    # The sanitizer reports and continues; a clean checker verdict — or
+    # a WEDGED run, the likeliest place for a race report to be waiting
+    # under a 5-15x-slowed SUT — with reports in the logs is a finding.
+    san_warnings = (scan_sanitizer_logs(cluster, nodes, args.san)
+                    if args.san else 0)
+    if err is not None:
+        return {"seed": seed, "workload": workload, "nodes": n,
+                "valid": None, "error": err,
+                "san_warnings": san_warnings, "store_dir": str(workdir)}
     res = test["results"]
     wl = res.get("workload", {})
     return {
@@ -111,6 +154,7 @@ def one_run(i: int, args, workload: str, n: int, workdir: Path) -> dict:
         "nodes": n,
         "workload": workload,
         "valid": wl.get("valid?"),
+        "san_warnings": san_warnings,
         "ok_ops": sum(1 for op in test["history"] if op.type == "ok"),
         "info_ops": sum(1 for op in test["history"] if op.type == "info"),
         "store_dir": test["store_dir"],
@@ -160,6 +204,11 @@ def _pressure(wl: dict) -> dict:
 def main(argv=None) -> int:
     args = parse_args(argv)
     pin_cpu(8)  # the checker side; the cluster is real processes either way
+    if args.san == "asan" and "ASAN_OPTIONS" not in os.environ:
+        # ASAN halts the process on the first error by default — the
+        # soak wants a full run of reports, not a dead node that caps
+        # coverage at one finding (an operator-set ASAN_OPTIONS wins).
+        os.environ["ASAN_OPTIONS"] = "halt_on_error=0"
     workloads = [w.strip() for w in args.workloads.split(",") if w.strip()]
     for w in workloads:
         if w not in WORKLOAD_SM:
@@ -183,6 +232,10 @@ def main(argv=None) -> int:
             r = {"seed": args.seed + i, "workload": workload, "nodes": n,
                  "valid": None, "error": f"{type(e).__name__}: {e}",
                  "store_dir": str(workdir)}
+        if r.get("san_warnings"):
+            r["valid"] = False
+            msg = f"{r['san_warnings']} sanitizer report(s) in SUT logs"
+            r["error"] = f"{r['error']}; {msg}" if r.get("error") else msg
         keep = args.keep_stores or r["valid"] is not True
         if not keep:
             shutil.rmtree(workdir, ignore_errors=True)

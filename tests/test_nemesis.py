@@ -210,3 +210,32 @@ def test_compose_test_hell_run(tmp_path):
         assert sum(1 for op in test["history"] if op.type == OK) > 50
     finally:
         cluster.shutdown()
+
+
+def test_grow_until_full_is_paced():
+    """The member package's healing generator must not spin: a grow that
+    fails instantly would otherwise re-emit back-to-back and spray the
+    final phase with unbounded info ops (a starved round-5 TSAN soak
+    recorded 101k grow attempts in one run)."""
+    import random
+
+    from jepsen_jgroups_raft_tpu.generator.base import PENDING
+    from jepsen_jgroups_raft_tpu.nemesis.package import member_package
+
+    pkg = member_package({"interval": 1.0}, db=None,
+                         rng=random.Random(0))
+    gen = pkg.final_generator
+    test = {"members": ["n1"], "nodes": ["n1", "n2", "n3"]}
+    t0 = 1_000_000_000  # ns
+    r = gen.op(test, {"time": t0})
+    assert r[0] != PENDING and r[0]["f"] == "grow"
+    gen = r[1]
+    # Immediately after an emission (same clock): paced, not a re-emit.
+    assert gen.op(test, {"time": t0})[0] == PENDING
+    # After the pace window it emits again...
+    r = gen.op(test, {"time": t0 + int(0.3 * 1e9)})
+    assert r[0] != PENDING and r[0]["f"] == "grow"
+    # ...and once the membership is full it exhausts.
+    assert r[1].op({"members": ["n1", "n2", "n3"],
+                    "nodes": ["n1", "n2", "n3"]},
+                   {"time": t0 + int(1e9)}) is None

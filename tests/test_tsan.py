@@ -20,20 +20,15 @@ import pytest
 
 from jepsen_jgroups_raft_tpu.deploy.local import (BlockNet, LocalCluster,
                                                   wait_for_port)
-from jepsen_jgroups_raft_tpu.native import NATIVE_DIR, ensure_built
+from jepsen_jgroups_raft_tpu.native import (NATIVE_DIR, SAN_MARKERS,
+                                            ensure_built)
 from jepsen_jgroups_raft_tpu.native.client import NativeConn, NativeRsmConn
 
 pytestmark = pytest.mark.slow
 
 NODES = ["n1", "n2", "n3"]
 
-MARKERS = {
-    "tsan": ("WARNING: ThreadSanitizer",),
-    # No LeakSanitizer marker: every node exit here is SIGKILL, so LSAN's
-    # atexit check never runs — listing it would claim coverage that
-    # doesn't exist.
-    "asan": ("ERROR: AddressSanitizer",),
-}
+MARKERS = SAN_MARKERS  # shared with soak_hell's --san scanner
 
 
 def _run_faulted_workload(cluster):
