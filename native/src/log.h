@@ -92,6 +92,11 @@ class RaftLog {
     return last_index();
   }
 
+  // Public: a pure function, and the byte-mutation fuzz needs it to
+  // craft CRC-VALID corrupted sidecars (a stale CRC is just rejected,
+  // which exercises nothing past load_synced).
+  static uint32_t crc32_of(const char* p, size_t n) { return crc32(p, n); }
+
   // Drop every entry with index >= from_index (conflict resolution).
   // Entries at or below the snapshot base are committed-and-applied on
   // this node; Raft safety says they can never conflict — refuse.

@@ -432,3 +432,22 @@ def test_log_selftest_review_findings_failstop(tmp_path, mode, needle):
         capture_output=True, text=True, timeout=30)
     assert out.returncode != 0
     assert needle in out.stderr
+
+
+def test_log_selftest_byte_mutation_fuzz(tmp_path):
+    """Adversarial byte-mutation fuzz over recovery (round 5): random
+    flips/truncations/extensions/sidecar damage; every trial must
+    either load a clean PREFIX of the original entries or deliberately
+    fail-stop — never crash or decode garbage (child-process verified,
+    fork-per-trial)."""
+    import subprocess
+
+    from jepsen_jgroups_raft_tpu.native import BUILD_DIR, ensure_built
+
+    ensure_built()
+    out = subprocess.run(
+        [str(BUILD_DIR / "log_selftest"), str(tmp_path / "log"),
+         "fuzz", "17", "150"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "LOG_FUZZ_PASS" in out.stdout
