@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# One-command on-chip certification (VERDICT r4 #1/#2/#6 + config-4).
+#
+# Run on the first TPU-attached session. Produces a timestamped
+# artifact directory under bench_runs/ with every measurement the
+# round-4/5 perf work needs to graduate from "CPU-measured, chip
+# pending":
+#   1. north-star bench (BENCH json line; columnar encode + async
+#      window-group launches land here)
+#   2. full suite (configs 1-5; config 4 is the many-long row whose
+#      canonical number predates the async-launch fix)
+#   3. pallas compete-or-retire (the round-5 batch-parallel tile kernel
+#      vs the XLA dense kernel on the same bench)
+#   4. routing calibration incl. the scan-unroll sweep (sets
+#      JGRAFT_ROUTE_MIN_CELLS / JGRAFT_SCAN_UNROLL from measurement)
+#   5. Pallas hardware (Mosaic) test
+#   6. a profiler trace of the north-star run (JGRAFT_PROFILE_DIR)
+#
+# Afterwards: update BASELINE.md's canonical table + engine-ablation
+# row, PLATFORM_ROUTE_MIN_CELLS and scan_unroll() defaults if the
+# measurements move them, and doc/running.md's measured-gates table.
+set -u  # not -e: later steps must run even if an earlier one degrades
+
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%dT%H%M%S)
+out="bench_runs/certify_${ts}"
+mkdir -p "$out"
+echo "artifacts -> $out"
+
+probe() {
+  timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform)" \
+    2>/dev/null | tail -1
+}
+
+platform=$(probe)
+echo "platform probe: ${platform:-TIMEOUT}" | tee "$out/platform.txt"
+if [ "${platform:-}" != "tpu" ] && [ "${platform:-}" != "axon" ]; then
+  echo "NO CHIP (tunnel down/wedged) — aborting; nothing recorded as" \
+       "on-chip evidence" | tee -a "$out/platform.txt"
+  exit 2
+fi
+
+echo "== 1/6 north-star bench"
+python bench.py 2>&1 | tee "$out/bench_northstar.log"
+
+echo "== 2/6 suite (configs 1-5)"
+python bench.py --suite 2>&1 | tee "$out/bench_suite.log"
+
+echo "== 3/6 pallas compete-or-retire"
+JGRAFT_KERNEL=pallas python bench.py 2>&1 | tee "$out/bench_pallas.log"
+
+echo "== 4/6 routing calibration + unroll sweep"
+python scripts/calibrate_routing.py --unroll 2>&1 \
+  | tee "$out/calibrate.log"
+
+echo "== 5/6 pallas hardware (Mosaic) test"
+python -m pytest tests/test_pallas_scan.py -q 2>&1 \
+  | tee "$out/pallas_hw_test.log"
+
+echo "== 6/6 profiler trace of the north-star run"
+JGRAFT_PROFILE_DIR="$out/profile" python bench.py 2>&1 \
+  | tee "$out/bench_profiled.log"
+
+echo "done — review $out and promote BASELINE.md rows"
