@@ -37,12 +37,25 @@ from ..native.client import NativeConn, make_conn_factory
 from .base import RaftDB
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_ports(n: int) -> list:
+    """`n` distinct free ports. All probe sockets stay OPEN until every
+    port is collected: closing each probe before the next bind lets the
+    kernel recycle a just-freed port into a later probe of the SAME
+    allocation — a 120-run hell campaign dealt one 7-node cluster
+    duplicate client ports exactly that way (round-5 finding; the node
+    died at bind and setup timed out)."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 
 
 def wait_for_port(host: str, port: int, timeout: float = 20.0) -> None:
@@ -80,12 +93,29 @@ class LocalCluster:
         self.workdir.mkdir(parents=True, exist_ok=True)
         self.ports: Dict[str, Tuple[int, int]] = {}
         self.procs: Dict[str, subprocess.Popen] = {}
-        for n in names:
-            self._alloc(n)
+        names = list(names)
+        # One batched allocation: every probe socket held open until all
+        # ports are dealt, so no two nodes of THIS batch can receive the
+        # same port. Later batches (grow-added members) re-check against
+        # the recorded ports in _alloc.
+        ports = _free_ports(2 * len(names))
+        for i, n in enumerate(names):
+            self.ports[n] = (ports[2 * i], ports[2 * i + 1])
 
     def _alloc(self, name: str) -> None:
-        if name not in self.ports:
-            self.ports[name] = (_free_port(), _free_port())
+        if name in self.ports:
+            return
+        # Late-added member (grow nemesis): a fresh batch can be dealt a
+        # port RECORDED for a currently-dead node (its sockets are
+        # unbound, so the kernel may reuse them) — colliding the moment
+        # the kill nemesis restarts that node. Retry until disjoint.
+        taken = {p for pair in self.ports.values() for p in pair}
+        for _ in range(64):
+            pair = tuple(_free_ports(2))
+            if not taken & set(pair):
+                self.ports[name] = pair
+                return
+        raise RuntimeError(f"no ports disjoint from {sorted(taken)}")
 
     def spec(self, name: str) -> str:
         self._alloc(name)

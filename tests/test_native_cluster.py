@@ -352,3 +352,15 @@ def test_server_survives_malformed_frames(tmp_path):
             c.close()
     finally:
         cluster.shutdown()
+
+
+def test_port_allocation_is_collision_free():
+    """All of a cluster's ports are dealt in ONE batch with every probe
+    socket held open — sequential probe-and-close let the kernel
+    recycle a just-freed port into the same cluster (round-5 campaign
+    finding: duplicate client ports killed a 7-node run at bind)."""
+    from jepsen_jgroups_raft_tpu.deploy.local import _free_ports
+
+    for _ in range(50):
+        ports = _free_ports(14)  # a 7-node cluster's worth
+        assert len(set(ports)) == 14, ports

@@ -239,3 +239,4 @@ def test_grow_until_full_is_paced():
     assert r[1].op({"members": ["n1", "n2", "n3"],
                     "nodes": ["n1", "n2", "n3"]},
                    {"time": t0 + int(1e9)}) is None
+

@@ -15,8 +15,9 @@ def H(*rows):
 
 
 def free_port() -> int:
-    """An ephemeral localhost port (shared helper; also mirrored by the
-    deploy tier's internal _free_port)."""
+    """An ephemeral localhost port (the deploy tier allocates its own
+    in collision-free batches via _free_ports; this single-port form
+    serves tests that need one listener)."""
     import socket
 
     s = socket.socket()
