@@ -140,6 +140,34 @@ def fail(msg: str, **extra) -> None:
           "vs_baseline": 0.0, "error": msg, **extra})
 
 
+def best_of(fn, profile_dir: str | None = None):
+    """Run `fn` JGRAFT_BENCH_REPS times (default 3, floor 1) and return
+    (best_result, [wall_s...]) by the first tuple element — or by the
+    call's own wall clock when `fn` returns a non-tuple. Identical dense
+    runs spanned 249-475 hist/s across the tunnel during the first
+    on-chip certification: a single timed pass measures the network's
+    mood, not the machine, so every bench row reports its best rep with
+    the full spread preserved in the artifact. `profile_dir` wraps the
+    FIRST rep in a profiler trace (JGRAFT_PROFILE_DIR plumbing)."""
+    n = max(1, int(os.environ.get("JGRAFT_BENCH_REPS", "3")))
+    results = []
+    for i in range(n):
+        if i == 0 and profile_dir:
+            import jax.profiler
+
+            with jax.profiler.trace(profile_dir):
+                t0 = time.perf_counter()
+                r = fn()
+                wall = time.perf_counter() - t0
+        else:
+            t0 = time.perf_counter()
+            r = fn()
+            wall = time.perf_counter() - t0
+        results.append((r, r[0] if isinstance(r, tuple) else wall))
+    best, _ = min(results, key=lambda p: p[1])
+    return best, [w for _, w in results]  # raw; emit rounds for display
+
+
 def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     import jax
 
@@ -171,8 +199,52 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     # (kernel cost is exponential in W; a batch's windows spread with how
     # many ops crashed per history); sort-kernel ladder for the rest.
     grouped, rest = dense_plans_grouped(model, encs)
+    # JGRAFT_KERNEL=pallas makes the driver bench measure the Pallas tile
+    # kernel on the same groups — the engine-ablation row. Without this
+    # the env knob silently measured dense twice (caught by the first
+    # on-chip certification, bench_runs/certify_20260731T005939).
+    want_pallas = os.environ.get("JGRAFT_KERNEL") == "pallas"
+
+    def run_pallas():
+        from jepsen_jgroups_raft_tpu.history.packing import (
+            pad_batch_bucketed)
+        from jepsen_jgroups_raft_tpu.ops.pallas_scan import (
+            make_pallas_batch_checker)
+
+        import numpy as np
+
+        interpret = jax.default_backend() != "tpu"  # CPU: interpreter
+        t0 = time.perf_counter()
+        batch = pack_batch(encs)
+        t1 = time.perf_counter()
+        # Launch every group's kernel (lazy device arrays), block once
+        # after the loop — same pipelining discipline as the dense path,
+        # so the ablation compares kernels, not blocking strategies.
+        launched = []
+        for idxs, plan in grouped:
+            ev, (val_of,), B = pad_batch_bucketed(batch["events"][idxs],
+                                                  (plan.val_of,))
+            kern = make_pallas_batch_checker(model, plan.n_slots,
+                                             plan.n_states, ev.shape[1],
+                                             interpret=interpret)
+            ok, _ = kern(ev, val_of)
+            launched.append((ok, B))
+        n_valid = sum(int(np.asarray(ok)[:B].sum()) for ok, B in launched)
+        n_unknown = 0
+        if rest:
+            # Histories beyond the dense caps aren't pallas-eligible;
+            # route them through the sort ladder like the dense run does
+            # (dropping them would trip the verdict-mismatch guard).
+            _, _, nv, nu = check_batch_sharded(
+                model, batch["events"][rest], mesh, n_slots=n_slots)
+            n_valid += nv
+            n_unknown += nu
+        t2 = time.perf_counter()
+        return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
 
     def run():
+        if want_pallas:
+            return run_pallas()
         t0 = time.perf_counter()
         batch = pack_batch(encs)
         t1 = time.perf_counter()
@@ -196,7 +268,8 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
 
     run()  # warm-up: compile
-    dt, dt_pack, dt_kernel, n_valid, n_unknown = run()
+    (dt, dt_pack, dt_kernel, n_valid, n_unknown), rep_times = best_of(
+        run, profile_dir=os.environ.get("JGRAFT_PROFILE_DIR"))
 
     if n_valid + n_unknown != n_histories or n_unknown > 0:
         # Soundness check: every synthetic history is valid by construction.
@@ -221,8 +294,10 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "n_histories": n_histories,
         "n_ops": n_ops,
         "n_procs": n_procs,
-        "kernel": sorted({p.kernel_tag for _, p in grouped} |
-                         ({"sort"} if rest else set())),
+        "kernel": (sorted({"pallas"} | ({"sort"} if rest else set()))
+                   if want_pallas else
+                   sorted({p.kernel_tag for _, p in grouped} |
+                          ({"sort"} if rest else set()))),
         "concurrency_window": max(
             [p.n_slots for _, p in grouped] + [n_slots if rest else 0]),
         "window_groups": [[p.n_slots, len(ix)] for ix, p in grouped] +
@@ -230,6 +305,9 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),
+        # value/time_s are the best rep; the full spread stays in the
+        # artifact so the tunnel's variance is never laundered away.
+        "rep_times_s": [round(t, 3) for t in rep_times],
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
         "platform_note": platform_note,
@@ -269,15 +347,19 @@ def run_suite(platform_note: str) -> None:
         # window) kernel-cache entry and the timed run would pay the
         # multi-second XLA compile.
         check_histories(hists, model, algorithm="jax")
-        t0 = time.perf_counter()
-        rs = check_histories(hists, model, algorithm="jax")
-        dt = time.perf_counter() - t0
+        # Best-of-3 like the north-star bench: single-shot suite rows
+        # measured the tunnel's mood (config 4 read 3.08 hist/s in the
+        # same session a warm in-process A/B measured 9.5).
+        rs, times = best_of(
+            lambda: check_histories(hists, model, algorithm="jax"))
+        dt = min(times)
         bad = [r for r in rs if r["valid?"] is not True]
         kernels = sorted({r.get("kernel", r["algorithm"]) for r in rs})
         emit({"config": name, "histories": len(hists),
               "time_s": round(dt, 3),
               "histories_per_sec": round(len(hists) / dt, 2),
               "invalid_or_unknown": len(bad), "kernel": kernels,
+              "rep_times_s": [round(t, 3) for t in times],
               "platform": platform})
 
     rng = _random.Random(3)
@@ -302,18 +384,21 @@ def run_suite(platform_note: str) -> None:
                                time_limit=max(8.0, 90.0 * scale))
     record_dt = time.perf_counter() - t0
     from jepsen_jgroups_raft_tpu.checker.recorded import check_recorded
-    t0 = time.perf_counter()
     # auto: the product path — on-device kernels plus sound CPU
     # escalation for the timeout-polluted keys whose windows outgrow the
-    # kernels (partition nemesis histories produce a few).
-    summary = check_recorded([run_dir], algorithm="auto")
-    dt = time.perf_counter() - t0
+    # kernels (partition nemesis histories produce a few). Warm once
+    # (compile), then best-of-3 like every other row.
+    check_recorded([run_dir], algorithm="auto")
+    summary, times = best_of(
+        lambda: check_recorded([run_dir], algorithm="auto"))
+    dt = min(times)
     emit({"config": "3: recorded 512-key register+partition",
           "histories": summary["histories"],
           "record_time_s": round(record_dt, 1),
           "time_s": round(dt, 3),
           "histories_per_sec": round(summary["histories"] / dt, 2),
           "invalid_or_unknown": summary["n-invalid"] + summary["n-unknown"],
+          "rep_times_s": [round(t, 3) for t in times],
           "platform": platform})
 
     # 4: independent multi-key, 10k ops per history.

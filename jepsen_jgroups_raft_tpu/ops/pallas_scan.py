@@ -15,34 +15,47 @@ north-star shape) left the 8×128-lane VPU ~97% idle per step while the
 vmapped XLA kernel batched histories. Each grid program now carries a
 TILE of T histories with the frontier laid out **F[2^W, T·S]** — lanes
 carry (history, state) pairs, T sized so T·S fills the 128-lane axis
-(T=32 at S=4) under a VMEM events budget:
+(T=32 at S=4) under a VMEM events budget.
 
-  * expansion (slot w, uniform across the tile): ONE [M, T·S] @
-    [T·S, T·S] matmul against a BLOCK-DIAGONAL transition matrix (zero
-    across history blocks — built rank-2 from a same-history iota mask),
-    then the same static row-shift butterfly as before. Per-history
-    open/legal gating lives inside the block diagonal.
-  * FORCE (slot differs per history): W kill+shift variants are
-    computed (cheap [M, T·S] elementwise) and column-selected per
-    history block by lane masks; survivors' liveness reduces per block
-    via a [1, T·S] @ [T·S, T·S] block-mask matmul, so `ok` stays a
-    lane-replicated row — no reshape/transpose of per-history scalars.
-  * closure runs when ANY tile member forces with a dirty frontier;
-    members mid-OPEN just re-close — idempotent (closure is a
-    reachability fixpoint; expanding at an OPEN computes the same
-    configs the deferred fixpoint would), so early closure is a
-    work-only cost, never a semantic one.
+Lane-row layout (the first on-chip session's Mosaic lesson): the
+original tile rewrite bridged per-history planes to lane rows with
+`(T, S) → (1, T·S)` / `(T, S) → (T·S, 1)` reshapes, and Mosaic rejects
+exactly that shape cast ("infer-vector-layout: unsupported shape cast",
+`tpu.reshape vector<16x4xi32> -> vector<1x64xi32>`;
+bench_runs/certify_20260731T005939/pallas_hw_test.log). So nothing in
+this kernel ever holds a (T, S) plane:
 
-Everything stays rank-2 for Mosaic. The two layout bridges —
-(T, S) → (1, T·S) and (T, S) → (T·S, 1) collapses — are the only
-reshape patterns used; both touch trailing dims only.
+  * per-event fields are pre-expanded to lane rows OUTSIDE the kernel —
+    event e's five int32 fields become five `[1, C]` rows (C = T·S)
+    with each history's scalar replicated across its S lanes, and
+    `val_of` is pre-flattened to `[1, C]` per tile. The expansion runs
+    as plain XLA ops inside the jitted call (the compact `[B, E, 5]`
+    array is what crosses the tunneled host↔device link; see
+    `_expand_lane_rows`), so Mosaic never sees a reshape.
+  * per-slot carries live as `[W, C]` lane-row stacks (static row
+    slices feed each transition), not `[T, W]` planes.
+  * the only row→column move the math needs (the transition matrix
+    wants next-state as a `[C, 1]` column) is an identity-mask
+    reduction: `sum(I ⊙ row, axis=1)` — elementwise multiply plus a
+    lane reduction, both native Mosaic ops, no transpose, no reshape.
+
+Per event the expansion (slot w, uniform across the tile) is ONE
+`[M, C] @ [C, C]` matmul against a block-diagonal transition matrix
+(zero across history blocks — built rank-2 from a same-history iota
+mask) followed by the static row-shift butterfly; FORCE kills are
+column-masked kill+shift variants reduced per history block via a
+`[1, C] @ [C, C]` block-mask matmul, so `ok` stays a lane-replicated
+row. Closure runs when ANY tile member forces with a dirty frontier;
+members mid-OPEN just re-close — idempotent (closure is a reachability
+fixpoint; expanding at an OPEN computes the same configs the deferred
+fixpoint would), so early closure is a work-only cost, never a
+semantic one.
 
 Status: opt-in (`JGRAFT_KERNEL=pallas` routes eligible register batches
 here; see checker/linearizable.py) and validated against the XLA dense
-kernel and the CPU oracle by differential tests in interpret mode —
-hardware (Mosaic) validation + the compete-or-retire measurement run on
-the first TPU-attached session via tests/test_pallas_scan.py and
-BASELINE.md's engine-ablation row.
+kernel and the CPU oracle by differential tests in interpret mode plus
+the hardware (Mosaic) test on real TPU; the compete-or-retire
+measurement lives in BASELINE.md's engine-ablation row.
 """
 
 from __future__ import annotations
@@ -60,16 +73,21 @@ from ..history.packing import EV_FORCE, EV_OPEN
 _LANE_TARGET = 128
 
 #: VMEM budget for one program's event block (bytes). Conservative slice
-#: of ~16 MiB usable VMEM: events dominate ([T, E, 5] int32); the
+#: of ~16 MiB usable VMEM: events dominate ([5·E, C] int32 after the
+#: host's lane expansion — C ≤ 128 lanes, so ≤ 2560·E bytes); the
 #: frontier itself is ≤ 2^10 × 128 × 4 B = 512 KiB.
 _EVENTS_VMEM_BUDGET = 6 << 20
 
 
 def tile_histories(n_states: int, n_events: int) -> int:
     """Histories per grid program: fill the lane axis, stay inside the
-    events VMEM budget, power of two for stable compile shapes."""
+    events VMEM budget, power of two for stable compile shapes. The
+    lane-expanded event block is [5·E, T·S] int32, so VMEM charges
+    T·S·E·20 bytes — n_states now scales the block (each history's
+    fields are replicated across its S lanes)."""
     by_lanes = max(1, _LANE_TARGET // max(1, int(n_states)))
-    by_vmem = max(1, _EVENTS_VMEM_BUDGET // max(1, int(n_events) * 5 * 4))
+    by_vmem = max(1, _EVENTS_VMEM_BUDGET
+                  // max(1, int(n_events) * 5 * 4 * int(n_states)))
     t = 1
     while t * 2 <= min(by_lanes, by_vmem):
         t *= 2
@@ -77,54 +95,62 @@ def tile_histories(n_states: int, n_events: int) -> int:
 
 
 def _build_kernel(model, W: int, S: int, E: int, T: int):
-    """Kernel body over one T-history tile, closed over static shapes."""
+    """Kernel body over one T-history tile, closed over static shapes.
+
+    Refs: events_ref [5·E, C] (row 5e+k = field k of event e as a lane
+    row, this tile's block), val_ref / out_ref [G, C] (FULL arrays,
+    constant index map — Mosaic's block rule demands sublane dims be
+    multiples of 8 or whole-array, and these are a few rows; each
+    program touches only its program_id row). C = T·S; history t owns
+    lanes [t·S, (t+1)·S); every per-history scalar is replicated across
+    its block's lanes."""
     M = 1 << W
     C = T * S
 
     def kernel(events_ref, val_ref, out_ref):
-        val = val_ref[...]                      # [T, S]
-        val_row = val.reshape(1, C)             # history-major lanes
-        mask_ids = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)
-        same_t = (jax.lax.broadcasted_iota(jnp.int32, (C, C), 0) // S ==
-                  jax.lax.broadcasted_iota(jnp.int32, (C, C), 1) // S)
+        val_row = val_ref[pl.ds(pl.program_id(0), 1), :]  # [1, C]
+        mask_ids = lax.broadcasted_iota(jnp.int32, (M, 1), 0)
+        lane_c0 = lax.broadcasted_iota(jnp.int32, (C, C), 0)
+        lane_c1 = lax.broadcasted_iota(jnp.int32, (C, C), 1)
+        same_t = lane_c0 // S == lane_c1 // S
         blockmask = same_t.astype(jnp.float32)  # [C, C] block-sum matmul
-        lane_s = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1) % S
+        ident = (lane_c0 == lane_c1).astype(jnp.int32)
+        lane_s = lax.broadcasted_iota(jnp.int32, (1, C), 1) % S
+        w_iota = lax.broadcasted_iota(jnp.int32, (W, C), 0)
 
-        def flat(x_t1):
-            """[T, 1] per-history scalar → [1, C] lane-replicated row."""
-            return jnp.broadcast_to(x_t1, (T, S)).reshape(1, C)
+        def to_col(row):
+            """[1, C] lane row → [C, 1] column without transpose/reshape:
+            identity-mask then reduce along lanes (row broadcasts down
+            the sublane axis; exactly one survivor per output row)."""
+            return jnp.sum(ident * row, axis=1, keepdims=True)
 
         def transition(w, slot_f, slot_a, slot_b, slot_open):
             """Block-diagonal T_w[C, C]: history t's [S, S] transition
             for its slot-w registers, zero across blocks."""
-            ns, legal = model.jax_step(val, slot_f[:, w:w + 1],
-                                       slot_a[:, w:w + 1],
-                                       slot_b[:, w:w + 1])      # [T, S]
-            legal = legal & (slot_open[:, w:w + 1] > 0)
-            ns_col = ns.reshape(C, 1)
-            legal_col = legal.reshape(C, 1)
-            return ((ns_col == val_row) & legal_col &
+            ns, legal = model.jax_step(val_row, slot_f[w:w + 1],
+                                       slot_a[w:w + 1],
+                                       slot_b[w:w + 1])   # [1, C] each
+            legal = legal & (slot_open[w:w + 1] > 0)
+            ns_col = to_col(ns)
+            legal_col = to_col(legal.astype(jnp.int32))
+            return ((ns_col == val_row) & (legal_col > 0) &
                     same_t).astype(jnp.float32)
 
         def event_step(e, carry):
-            F, slot_f, slot_a, slot_b, slot_open, ok_col, dirty_col = carry
-            ev = events_ref[:, pl.ds(e, 1), :][:, 0, :]          # [T, 5]
-            etype, slot = ev[:, 0:1], ev[:, 1:2]
-            f, a, b = ev[:, 2:3], ev[:, 3:4], ev[:, 4:5]
-            is_open = etype == EV_OPEN
-            is_force = etype == EV_FORCE
+            F, slot_f, slot_a, slot_b, slot_open, ok_row, dirty_row = carry
+            ev = events_ref[pl.ds(e * 5, 5), :]           # [5, C]
+            etype_row, slot_row = ev[0:1, :], ev[1:2, :]
+            f_row, a_row, b_row = ev[2:3, :], ev[3:4, :], ev[4:5, :]
+            is_open = (etype_row == EV_OPEN).astype(jnp.int32)
+            is_force = (etype_row == EV_FORCE).astype(jnp.int32)
 
-            lane_w = jax.lax.broadcasted_iota(jnp.int32, (T, W), 1)
-            upd = ((lane_w == slot) & is_open).astype(jnp.int32)
-            slot_f = slot_f * (1 - upd) + f * upd
-            slot_a = slot_a * (1 - upd) + a * upd
-            slot_b = slot_b * (1 - upd) + b * upd
+            upd = ((w_iota == slot_row).astype(jnp.int32) *
+                   is_open)                               # [W, C]
+            slot_f = slot_f * (1 - upd) + f_row * upd
+            slot_a = slot_a * (1 - upd) + a_row * upd
+            slot_b = slot_b * (1 - upd) + b_row * upd
             slot_open = jnp.maximum(slot_open, upd)
-
-            open_col = flat(is_open.astype(jnp.int32))
-            force_col = flat(is_force.astype(jnp.int32))
-            slot_col = flat(slot)
-            dirty_col = jnp.maximum(dirty_col, open_col)
+            dirty_row = jnp.maximum(dirty_row, is_open)
 
             Ts = [transition(w, slot_f, slot_a, slot_b, slot_open)
                   for w in range(W)]
@@ -132,7 +158,7 @@ def _build_kernel(model, W: int, S: int, E: int, T: int):
             def sweep(F):
                 for w in range(W):
                     d = 1 << w
-                    no_row = 1 - ((mask_ids >> w) & 1)           # [M, 1]
+                    no_row = 1 - ((mask_ids >> w) & 1)    # [M, 1]
                     stepped = (jnp.dot(
                         F.astype(jnp.float32), Ts[w],
                         preferred_element_type=jnp.float32) > 0.5
@@ -154,10 +180,10 @@ def _build_kernel(model, W: int, S: int, E: int, T: int):
                 changed = jnp.sum(jnp.abs(F - F0)) > 0
                 return (changed & (it < W), it + 1, F)
 
-            need = jnp.sum(force_col * dirty_col) > 0
+            need = jnp.sum(is_force * dirty_row) > 0
             _, _, F = lax.while_loop(closure_cond, closure_body,
                                      (need, jnp.int32(0), F))
-            dirty_col = dirty_col * (1 - force_col)
+            dirty_row = dirty_row * (1 - is_force)
 
             # FORCE: per-history slot → column-selected kill+shift.
             Fk_sel = jnp.zeros((M, C), jnp.int32)
@@ -165,69 +191,82 @@ def _build_kernel(model, W: int, S: int, E: int, T: int):
             for w in range(W):
                 d = 1 << w
                 has_row = (mask_ids >> w) & 1
-                cm = ((slot_col == w) & (force_col > 0)).astype(jnp.int32)
+                cm = (slot_row == w).astype(jnp.int32) * is_force  # [1, C]
                 Fk = F * has_row
                 moved = jnp.concatenate(
                     [Fk[d:], jnp.zeros((d, C), jnp.int32)],
                     axis=0) * (1 - has_row)
                 Fk_sel = Fk_sel + Fk * cm
                 moved_sel = moved_sel + moved * cm
-            F = F * (1 - force_col) + moved_sel
+            F = F * (1 - is_force) + moved_sel
 
             colsum = jnp.sum(Fk_sel, axis=0,
                              keepdims=True).astype(jnp.float32)  # [1, C]
             blocksum = jnp.dot(colsum, blockmask,
                                preferred_element_type=jnp.float32)
-            alive_col = (blocksum > 0.5).astype(jnp.int32)
-            ok_col = ok_col * jnp.where((force_col > 0) & (alive_col == 0),
+            alive_row = (blocksum > 0.5).astype(jnp.int32)
+            ok_row = ok_row * jnp.where((is_force > 0) & (alive_row == 0),
                                         0, 1)
             slot_open = slot_open * (
-                1 - ((lane_w == slot) & is_force).astype(jnp.int32))
-            return (F, slot_f, slot_a, slot_b, slot_open, ok_col,
-                    dirty_col)
+                1 - (w_iota == slot_row).astype(jnp.int32) * is_force)
+            return (F, slot_f, slot_a, slot_b, slot_open, ok_row,
+                    dirty_row)
 
         # Initial config per history block: empty mask, state id 0.
         seed = ((mask_ids == 0) & (lane_s == 0)).astype(jnp.int32)
         carry = (seed,
-                 jnp.zeros((T, W), jnp.int32), jnp.zeros((T, W), jnp.int32),
-                 jnp.zeros((T, W), jnp.int32), jnp.zeros((T, W), jnp.int32),
+                 jnp.zeros((W, C), jnp.int32), jnp.zeros((W, C), jnp.int32),
+                 jnp.zeros((W, C), jnp.int32), jnp.zeros((W, C), jnp.int32),
                  jnp.ones((1, C), jnp.int32), jnp.zeros((1, C), jnp.int32))
         carry = lax.fori_loop(0, E, event_step, carry)
-        ok_col = carry[5]
-        # Scalar verdicts through SMEM (Mosaic rejects scalar VMEM
-        # stores); the TPU grid is sequential so per-row stores race-free.
-        for t in range(T):
-            out_ref[pl.program_id(0) * T + t, 0] = ok_col[0, t * S]
+        out_ref[pl.ds(pl.program_id(0), 1), :] = carry[5]  # [1, C]
 
     return kernel
+
+
+def _expand_lane_rows(events, T: int, S: int):
+    """[Bp, E, 5] int32 → [G·5·E, C] lane rows (G = Bp/T, C = T·S):
+    tile g's row 5e+k holds field k of event e, history t's scalar
+    replicated across lanes [t·S, (t+1)·S). Runs as jnp INSIDE the
+    jitted call — the compact [Bp, E, 5] array crosses the (tunneled)
+    host↔device link and XLA expands on device; Mosaic's no-reshape
+    rule only binds inside the pallas kernel."""
+    Bp, E, _ = events.shape
+    G = Bp // T
+    # (G, T, E, 5) → (G, E, 5, T) → repeat S on lanes → (G·5E, T·S)
+    lanes = jnp.repeat(
+        events.reshape(G, T, E, 5).transpose(0, 2, 3, 1), S, axis=3)
+    return lanes.reshape(G * E * 5, T * S)
 
 
 _CALL_CACHE: dict = {}
 
 
-def _build_call(model, W: int, S: int, E: int, T: int, Bp: int,
+def _build_call(model, W: int, S: int, E: int, T: int, G: int,
                 interpret: bool):
-    key = (*model.cache_key(), W, S, E, T, Bp, interpret)
+    key = (*model.cache_key(), W, S, E, T, G, interpret)
     cached = _CALL_CACHE.get(key)
     if cached is not None:
         return cached
     kernel = _build_kernel(model, W, S, E, T)
+    C = T * S
 
-    def call(events, val_of):
+    def call(events, val_rows):
+        ev_rows = _expand_lane_rows(events, T, S)
         return pl.pallas_call(
             kernel,
-            grid=(Bp // T,),
+            grid=(G,),
             in_specs=[
-                pl.BlockSpec((T, E, 5), lambda g: (g, 0, 0),
+                pl.BlockSpec((E * 5, C), lambda g: (g, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((T, S), lambda g: (g, 0),
+                pl.BlockSpec((G, C), lambda g: (0, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((Bp, 1), lambda g: (0, 0),
-                                   memory_space=pltpu.SMEM),
-            out_shape=jax.ShapeDtypeStruct((Bp, 1), jnp.int32),
+            out_specs=pl.BlockSpec((G, C), lambda g: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((G, C), jnp.int32),
             interpret=interpret,
-        )(events, val_of)
+        )(ev_rows, val_rows)
 
     jitted = jax.jit(call)
     _CALL_CACHE[key] = jitted
@@ -248,6 +287,15 @@ def make_pallas_batch_checker(model, n_slots: int, n_states: int,
         events = np.asarray(events, np.int32)
         val_of = np.asarray(val_of, np.int32)
         B = events.shape[0]
+        E = events.shape[1]
+        if E % 8:
+            # Mosaic block rule: the event block's sublane dim (5·E)
+            # must divide by 8 when the grid has >1 tile. EV_PAD rows
+            # are no-ops, so round E up (the kernel cache keys on E).
+            E8 = ((E + 7) // 8) * 8
+            events = np.concatenate(
+                [events, np.zeros((B, E8 - E, 5), np.int32)], axis=1)
+            E = E8
         # Clamp the tile to the batch: a 2-history long-event group must
         # not pay a 32-lane tile of per-event matmul work (the kernel
         # cache already keys on T).
@@ -262,8 +310,15 @@ def make_pallas_batch_checker(model, n_slots: int, n_states: int,
                 [events, np.zeros((Bp - B, E, 5), np.int32)])
             val_of = np.concatenate(
                 [val_of, np.zeros((Bp - B, S), np.int32)])
-        call = _build_call(model, W, S, E, T, Bp, bool(interpret))
-        ok = call(jnp.asarray(events), jnp.asarray(val_of))[:B, 0] > 0
+        G = Bp // T
+        val_rows = np.ascontiguousarray(val_of.reshape(G, T * S))
+        call = _build_call(model, W, S, E, T, G, bool(interpret))
+        ok_rows = call(jnp.asarray(events), jnp.asarray(val_rows))
+        # History t's verdict is lane t·S of its tile row (block-
+        # replicated; any lane would do). Stays a LAZY device array —
+        # callers launch several window groups and block once, and a
+        # host sync here would serialize a tunnel round trip per group.
+        ok = ok_rows.reshape(Bp, S)[:B, 0] > 0
         return ok, jnp.zeros_like(ok)
 
     return check
