@@ -5,16 +5,16 @@
 # artifact directory under bench_runs/ with every measurement the
 # round-4/5 perf work needs to graduate from "CPU-measured, chip
 # pending":
-#   1. north-star bench (BENCH json line; columnar encode + async
-#      window-group launches land here)
-#   2. full suite (configs 1-5; config 4 is the many-long row whose
-#      canonical number predates the async-launch fix)
-#   3. pallas compete-or-retire (the round-5 batch-parallel tile kernel
-#      vs the XLA dense kernel on the same bench)
-#   4. routing calibration incl. the scan-unroll sweep (sets
-#      JGRAFT_ROUTE_MIN_CELLS / JGRAFT_SCAN_UNROLL from measurement)
-#   5. Pallas hardware (Mosaic) test
-#   6. a profiler trace of the north-star run (JGRAFT_PROFILE_DIR)
+#   1. north-star bench (BENCH json line, best-of-3 with rep spread)
+#   2. full suite (configs 1-5, each row best-of-3)
+#   3. pallas driver-path bench (JGRAFT_KERNEL=pallas through bench.py)
+#   4. interleaved single-process A/Bs (ab_pallas.log, ab_unroll.log) —
+#      the only comparisons that resolve engine/knob differences under
+#      the tunnel's cross-process variance
+#   5. routing calibration incl. the scan-unroll sweep (per-shape
+#      LOWER bounds for JGRAFT_ROUTE_MIN_CELLS / JGRAFT_SCAN_UNROLL)
+#   6. Pallas hardware (Mosaic) test
+#   7. a profiler trace of the north-star run (JGRAFT_PROFILE_DIR)
 #
 # Afterwards: update BASELINE.md's canonical table + engine-ablation
 # row, PLATFORM_ROUTE_MIN_CELLS and scan_unroll() defaults if the
@@ -40,24 +40,37 @@ if [ "${platform:-}" != "tpu" ] && [ "${platform:-}" != "axon" ]; then
   exit 2
 fi
 
-echo "== 1/6 north-star bench"
+echo "== 1/7 north-star bench"
 python bench.py 2>&1 | tee "$out/bench_northstar.log"
 
-echo "== 2/6 suite (configs 1-5)"
+echo "== 2/7 suite (configs 1-5)"
 python bench.py --suite 2>&1 | tee "$out/bench_suite.log"
 
-echo "== 3/6 pallas compete-or-retire"
+echo "== 3/7 pallas compete-or-retire (driver path)"
 JGRAFT_KERNEL=pallas python bench.py 2>&1 | tee "$out/bench_pallas.log"
 
-echo "== 4/6 routing calibration + unroll sweep"
+echo "== 4/7 interleaved engine + unroll A/Bs"
+# The decisive comparisons: the 2026-07-31 session measured identical
+# dense benches at 249-475 hist/s across processes (tunnel latency
+# wander), so only single-process interleaved reps can resolve an
+# engine or knob difference. bench.py rows are best-of-3 for the same
+# reason.
+python scripts/ab_pallas.py 2>&1 | tee "$out/ab_pallas.log"
+python scripts/ab_unroll.py 2>&1 | tee "$out/ab_unroll.log"
+
+echo "== 5/7 routing calibration (per-shape lower bounds) + unroll sweep"
+# Treat recommendations as LOWER bounds: host-routed small groups
+# overlap with big chip launches in the real pipeline (config 4:
+# gate-64k 1.68 s vs all-chip 2.63 s, 2026-07-31), which isolated
+# per-shape probes cannot see.
 python scripts/calibrate_routing.py --unroll 2>&1 \
   | tee "$out/calibrate.log"
 
-echo "== 5/6 pallas hardware (Mosaic) test"
+echo "== 6/7 pallas hardware (Mosaic) test"
 python -m pytest tests/test_pallas_scan.py -q 2>&1 \
   | tee "$out/pallas_hw_test.log"
 
-echo "== 6/6 profiler trace of the north-star run"
+echo "== 7/7 profiler trace of the north-star run"
 JGRAFT_PROFILE_DIR="$out/profile" python bench.py 2>&1 \
   | tee "$out/bench_profiled.log"
 

@@ -31,6 +31,20 @@ def _h(rows):
     return h
 
 
+def _maybe_corrupt_read(h, rng):
+    """Bump one successful read's value so the history turns invalid
+    (when it has any such read) — the standard corruption used by every
+    differential here and in the TPU subprocess script."""
+    ops = list(h)
+    reads = [j for j, op in enumerate(ops)
+             if op.type == OK and op.f == "read" and op.value is not None]
+    if not reads:
+        return h
+    j = rng.choice(reads)
+    ops[j] = ops[j].replace(value=ops[j].value + 1)
+    return ops
+
+
 def _run_pallas(encs, model, interpret=True):
     plan = dense_plan(model, encs)
     assert plan is not None and plan.kind == "domain"
@@ -67,17 +81,41 @@ def test_pallas_differential_vs_cpu_interpret():
         h = random_valid_history(rng, "register", n_ops=40, n_procs=4,
                                  crash_p=0.15, max_crashes=3)
         if i % 2:
-            ops = list(h)
-            reads = [j for j, op in enumerate(ops)
-                     if op.type == OK and op.f == "read"
-                     and op.value is not None]
-            if reads:
-                j = rng.choice(reads)
-                ops[j] = ops[j].replace(value=ops[j].value + 1)
-                h = ops
+            h = _maybe_corrupt_read(h, rng)
         encs.append(encode_history(h, m))
     ok, overflow = _run_pallas(encs, m)
     assert not overflow.any()
+    for i, enc in enumerate(encs):
+        assert bool(ok[i]) is check_encoded_cpu(enc, m).valid, i
+
+
+def test_pallas_exact_event_shapes_pad_to_sublane_rule():
+    """Exact (non-bucketed) event lengths reach the kernel when the
+    checker takes the few-long-histories exact-shapes path; the wrapper
+    must pad E to a multiple of 8 (Mosaic's sublane block rule for
+    multi-tile grids) without changing verdicts. E=37 → 40 here."""
+    m = CasRegister()
+    rng = random.Random(7)
+    encs = []
+    for i in range(12):
+        h = random_valid_history(rng, "register", n_ops=18, n_procs=3,
+                                 crash_p=0.1, max_crashes=2)
+        if i % 3 == 0:
+            h = _maybe_corrupt_read(h, rng)
+        encs.append(encode_history(h, m))
+    plan = dense_plan(m, encs)
+    assert plan is not None and plan.kind == "domain"
+    # floor_e=None keeps the exact max event length instead of bucketing
+    # to a power of two; append EV_PAD no-op events to force an odd E.
+    ev, (val_of,), B = pad_batch_bucketed(pack_batch(encs)["events"],
+                                          (plan.val_of,), floor_e=None)
+    if ev.shape[1] % 8 == 0:
+        ev = np.concatenate(
+            [ev, np.zeros((ev.shape[0], 5, 5), ev.dtype)], axis=1)
+    assert ev.shape[1] % 8 != 0, "shape must exercise the E-padding path"
+    kernel = make_pallas_batch_checker(m, plan.n_slots, plan.n_states,
+                                       ev.shape[1], interpret=True)
+    ok = np.asarray(kernel(ev, val_of)[0])[:B]
     for i, enc in enumerate(encs):
         assert bool(ok[i]) is check_encoded_cpu(enc, m).valid, i
 
@@ -159,6 +197,15 @@ kernel = make_pallas_batch_checker(m, plan.n_slots, plan.n_states,
 ok = np.asarray(kernel(ev, val_of)[0])[:B]
 for i, enc in enumerate(encs):
     assert bool(ok[i]) is check_encoded_cpu(enc, m).valid, i
+# Odd-E variant: the wrapper's pad-to-multiple-of-8 path must satisfy
+# Mosaic's sublane block rule on a real multi-tile grid too.
+ev = np.concatenate([ev, np.zeros((ev.shape[0], 5, 5), ev.dtype)], axis=1)
+assert ev.shape[1] % 8 != 0
+kernel = make_pallas_batch_checker(m, plan.n_slots, plan.n_states,
+                                   ev.shape[1], interpret=False)
+ok = np.asarray(kernel(ev, val_of)[0])[:B]
+for i, enc in enumerate(encs):
+    assert bool(ok[i]) is check_encoded_cpu(enc, m).valid, ("oddE", i)
 print("TPU_PASS")
 """
 
