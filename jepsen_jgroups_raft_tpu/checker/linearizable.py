@@ -296,13 +296,16 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                     sub = [fits[j] for j in idxs]
                     batch = pack_batch([encs[i] for i in sub])
                     # Bucketing trades padding work for jit-cache
-                    # stability. For a FEW LONG histories the trade
-                    # inverts: padding a 2-history 16k-event group to 8
-                    # rows quadruples its kernel time, while the compile
-                    # cache only ever sees a handful of such launches —
-                    # use exact shapes there.
+                    # stability. For LONG histories the trade inverts:
+                    # bucketing a 12k-event cluster to 16k events adds
+                    # 33% sequential scan depth to every member, while
+                    # the compile cache only ever sees a handful of
+                    # long launches per process (merged clusters —
+                    # _merge_long_groups — make them fewer still, and
+                    # can exceed 16 rows, so exactness keys on
+                    # long-ness alone, not group size).
                     e_len = batch["events"].shape[1]
-                    exact = (e_len > MERGE_MAX_EVENTS and len(sub) <= 16)
+                    exact = e_len > MERGE_MAX_EVENTS
                     ev, (val_of,), B = pad_batch_bucketed(
                         batch["events"], (plan.val_of,),
                         floor_b=len(sub) if exact else 8,

@@ -127,12 +127,38 @@ def dense_plan(model, encs: Sequence[EncodedHistory]) -> Optional[DensePlan]:
 #: amortization beats a snugger W for tiny groups).
 DENSE_MIN_GROUP = 16
 
-#: ...unless the histories are LONG: kernel work is E · 2^W cells per
-#: history, so pushing a 15k-event W=6 history into a W=8 group costs 4×
-#: its whole scan — far more than the launch it saves. Past this event
-#: count every window gets its own snug launch (measured on config #4:
-#: merged-to-W=8 1.9 s vs per-window 1.3 s on v5e).
+#: Past this event count a history counts as LONG: launch amortization
+#: stops being the story and scan depth becomes it (see
+#: _merge_long_groups for the round-5 policy reversal).
 MERGE_MAX_EVENTS = 4096
+
+#: Long histories merge into one launch only while the group's window
+#: spread stays within this many slots of the widest member: per-step
+#: cost has a b·B·2^W·S width term, so folding a W=5 history into a
+#: W=12 launch would inflate its every step 128× — the depth saving
+#: cannot repay that. The measured config-#4 win spans spread 3 (W
+#: 6..9); beyond it, clusters launch separately (still merged within
+#: each cluster).
+MERGE_LONG_MAX_SPREAD = 3
+
+
+def _merge_long_groups() -> bool:
+    """Round-5 policy REVERSAL of per-window launches for LONG
+    histories. Launches serialize on a single TPU core, so per-window
+    groups pay the SUM of their scan depths (config #4: 4 groups ×
+    ~15-20k events ≈ 70k sequential steps), while one merged launch at
+    the widest window pays max-E once (~20k steps) at a higher
+    per-step width. At config-4 frontier sizes the depth cut wins:
+    interleaved in-process A/B on v5e (scripts/ab_merge_long.py,
+    2026-07-31, 5 reps each): merged min 2.348 s / median 2.514 s vs
+    per-window 3.187 / 3.342 — 1.36× at min, every merged rep faster
+    than every per-window rep. (The round-3 number that set the old
+    policy — merged 1.9 s vs per-window 1.3 s — was a cross-process
+    comparison, the methodology the tunneled chip later proved
+    unusable: identical benches span 249-677 hist/s across processes.)
+    The width term is real, so merging is bounded by
+    MERGE_LONG_MAX_SPREAD. JGRAFT_MERGE_LONG=0 restores per-window."""
+    return os.environ.get("JGRAFT_MERGE_LONG", "1") == "1"
 
 
 def _pad_domains(domains, idxs):
@@ -208,8 +234,57 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
             return None
         return (pending, DensePlan("domain", w_eff, S, val_of))
 
+    merge_long = _merge_long_groups()
     for kind in ("domain", "mask"):
         windows = sorted(w for k, w in buckets if k == kind)
+        if merge_long:
+            # Merge long histories of this kind into window-proximate
+            # cluster launches (see _merge_long_groups): shorts keep
+            # the per-window path below (merging a short history into
+            # a long launch would pad its event stream E_long/E_short×,
+            # which no launch saving repays).
+            longs = set(i for w in windows for i in buckets[(kind, w)]
+                        if encs[i].n_events > MERGE_MAX_EVENTS)
+            if longs:
+                for w in windows:
+                    buckets[(kind, w)] = [
+                        i for i in buckets[(kind, w)] if i not in longs]
+                windows = [w for w in windows if buckets[(kind, w)]]
+                by_w = sorted(longs, key=lambda i: encs[i].n_slots,
+                              reverse=True)
+                while by_w:
+                    w_top = encs[by_w[0]].n_slots
+                    cut = w_top - MERGE_LONG_MAX_SPREAD
+                    # Greedy take, re-checking the launch cell envelope
+                    # as members join (domains pad S to the cluster
+                    # max, pow2-bucketed): a member whose domain would
+                    # push 2^w_top · S_pad over the cap waits for a
+                    # later, narrower cluster instead of forcing flush
+                    # to shed the WIDEST member to the sort ladder —
+                    # every history here is dense-eligible alone and
+                    # must stay on the dense path. (A singleton always
+                    # fits: per-history eligibility used its own W and
+                    # unpadded S, and pow2 padding cannot double past
+                    # the cap at these sizes.)
+                    take, rest_long, s_run = [], [], 1
+                    for i in by_w:
+                        if encs[i].n_slots < cut:
+                            rest_long.append(i)
+                            continue
+                        s_new = max(s_run, len(domains[i])
+                                    if kind == "domain" else 1)
+                        s_pad = 1
+                        while s_pad < s_new:
+                            s_pad *= 2
+                        if take and (1 << w_top) * s_pad > DENSE_MAX_CELLS:
+                            rest_long.append(i)
+                            continue
+                        take.append(i)
+                        s_run = s_new
+                    by_w = rest_long
+                    g = flush(kind, take)
+                    if g is not None:
+                        groups.append(g)
         pending: list = []
         for w in windows:
             bucket = buckets[(kind, w)]
@@ -305,43 +380,52 @@ def _make_force_branches(bit_table: np.ndarray, W: int, S: int):
 
 
 def make_dense_history_checker(model, n_slots: int, n_states: int):
-    """Build fn(events [E,5], val_of [S]) -> (valid, overflow=False)."""
+    """Build fn(events [E,5], val_of [S]) -> (valid, overflow=False).
+
+    Step shape note (round-5): a gather-based rewrite of this kernel
+    (Jacobi closure over one [W,M,S] gather + einsum, gather-based
+    FORCE) measured ~2× SLOWER on v5e than this butterfly/switch form
+    (config-4 5.2 s vs 2.4 s, counter suite 12.3 s vs 7.0 s, same
+    session) — TPU gathers at these tiny shapes cost more than the
+    fusion count they save, which is exactly why the design invariant
+    in the module docstring says "no sort, no scatter, no gather".
+    The one salvaged piece: transition matrices live in the carry
+    (refreshed once per OPEN), so the closure sweeps stopped
+    re-evaluating model.jax_step W times per iteration."""
     W, S = int(n_slots), int(n_states)
     M = 1 << W
     slot_ids = jnp.arange(W, dtype=jnp.int32)
     bit_table = _bit_table(M, W)
     force_branches = _make_force_branches(bit_table, W, S)
 
-    def expand_w(w, F, val_of, slot_f, slot_a, slot_b, slot_open):
+    def expand_w(w, F, Te):
         """One slot's flow: configs without bit w linearize op w."""
-        ns, legal = model.jax_step(val_of, slot_f[w], slot_a[w], slot_b[w])
-        T = ((ns[:, None] == val_of[None, :]) & legal[:, None] &
-             slot_open[w]).astype(jnp.float32)  # [S, S]
         Fb = F.reshape(M >> (w + 1), 2, 1 << w, S)
         src = Fb[:, 0].reshape(-1, S).astype(jnp.float32)
-        contrib = (src @ T).reshape(M >> (w + 1), 1 << w, S) > 0
+        contrib = (src @ Te[w]).reshape(M >> (w + 1), 1 << w, S) > 0
         return jnp.concatenate(
             [Fb[:, :1], (Fb[:, 1] | contrib)[:, None]], axis=1
         ).reshape(M, S)
 
     def scan_step(carry, ev):
-        F, slot_f, slot_a, slot_b, slot_open, ok, dirty, val_of = carry
+        F, T, slot_open, ok, dirty, val_of = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
 
         onehot = slot_ids == slot
         upd = onehot & is_open
-        slot_f = jnp.where(upd, f, slot_f)
-        slot_a = jnp.where(upd, a, slot_a)
-        slot_b = jnp.where(upd, b, slot_b)
+        ns, legal = model.jax_step(val_of, f, a, b)
+        row = (ns[:, None] == val_of[None, :]) & legal[:, None]  # [S, S']
+        T = jnp.where(upd[:, None, None], row[None], T)
         slot_open = jnp.where(upd, True, slot_open)
         dirty = dirty | is_open
 
+        Te = (T & slot_open[:, None, None]).astype(jnp.float32)
+
         def sweep(F):  # static unroll; expansions chain w ascending
             for w in range(W):
-                F = expand_w(w, F, val_of, slot_f, slot_a, slot_b,
-                             slot_open)
+                F = expand_w(w, F, Te)
             return F
 
         # Closure only when an OPEN happened since the last one: a closed
@@ -356,15 +440,13 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~(onehot & is_force)
-        return (F, slot_f, slot_a, slot_b, slot_open, ok, dirty,
-                val_of), None
+        return (F, T, slot_open, ok, dirty, val_of), None
 
     def check(events, val_of):
         F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
         carry = (
             F,
-            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
-            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
+            jnp.zeros((W, S, S), bool), jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False), val_of,
         )
         carry, _ = lax.scan(scan_step, carry, events,
@@ -372,7 +454,7 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         # The dense frontier cannot overflow: the array is the whole
         # configuration space. Second output mirrors the sort kernel's
         # (valid, overflow) contract.
-        return carry[5], jnp.bool_(False)
+        return carry[3], jnp.bool_(False)
 
     return check
 
@@ -396,12 +478,9 @@ def make_mask_dense_history_checker(model, n_slots: int):
     bit_i32 = jnp.asarray(bit_table, jnp.int32)   # [M, W]
     force_branches = _make_force_branches(bit_table, W, 1)
 
-    def expand_w(w, F, base, sums, slot_f, slot_a, slot_b, slot_open):
-        state = base + sums  # [M]
-        _, legal = model.jax_step(state, slot_f[w], slot_a[w], slot_b[w])
-        legal = legal & slot_open[w]
+    def expand_w(w, F, legal_all):
         Fb = F.reshape(M >> (w + 1), 2, 1 << w, 1)
-        Lb = legal.reshape(M >> (w + 1), 2, 1 << w)
+        Lb = legal_all[w].reshape(M >> (w + 1), 2, 1 << w)
         grown = Fb[:, 1] | (Fb[:, 0] & Lb[:, 0][..., None])
         return jnp.concatenate([Fb[:, :1], grown[:, None]],
                                axis=1).reshape(M, 1)
@@ -428,10 +507,18 @@ def make_mask_dense_history_checker(model, n_slots: int):
         sums = jnp.where(is_open, sums + col * (new_d - old_d), sums)
         slot_delta = jnp.where(upd, new_d, slot_delta)
 
+        # Per-slot legality over ALL M config states at once: state and
+        # slot registers are closure-invariant, so this lifts the
+        # model.jax_step calls out of the fixpoint loop entirely (the
+        # old sweep re-evaluated them W times per iteration). [W, M].
+        state = base + sums
+        legal_all = jax.vmap(
+            lambda f_, a_, b_: (model.jax_step(state, f_, a_, b_)[1])
+        )(slot_f, slot_a, slot_b) & slot_open[:, None]
+
         def sweep(F):
             for w in range(W):
-                F = expand_w(w, F, base, sums, slot_f, slot_a, slot_b,
-                             slot_open)
+                F = expand_w(w, F, legal_all)
             return F
 
         # Closure only when dirtied by an OPEN since the last closure

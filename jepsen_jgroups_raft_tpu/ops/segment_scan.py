@@ -195,35 +195,36 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
     bit_table = _bit_table(M, W)
     force_branches = _make_force_branches(bit_table, W, S)
 
-    def expand_w(w, F, val_of, slot_f, slot_a, slot_b, slot_open):
-        ns, legal = model.jax_step(val_of, slot_f[w], slot_a[w], slot_b[w])
-        T = ((ns[:, None] == val_of[None, :]) & legal[:, None] &
-             slot_open[w]).astype(jnp.float32)  # [S, S]
+    def expand_w(w, F, Te):
         Fb = F.reshape(M >> (w + 1), 2, 1 << w, S)
         src = Fb[:, 0].reshape(-1, S).astype(jnp.float32)
-        contrib = (src @ T).reshape(M >> (w + 1), 1 << w, S) > 0
+        contrib = (src @ Te[w]).reshape(M >> (w + 1), 1 << w, S) > 0
         return jnp.concatenate(
             [Fb[:, :1], (Fb[:, 1] | contrib)[:, None]], axis=1
         ).reshape(M, S)
 
     def scan_step(carry, ev):
-        F, slot_f, slot_a, slot_b, slot_open, dirty, val_of = carry
+        F, T, slot_open, dirty, val_of = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
 
         onehot = slot_ids == slot
         upd = onehot & is_open
-        slot_f = jnp.where(upd, f, slot_f)
-        slot_a = jnp.where(upd, a, slot_a)
-        slot_b = jnp.where(upd, b, slot_b)
+        # Transition matrices live in the carry, refreshed once per
+        # OPEN — not re-derived from model.jax_step W times per closure
+        # sweep (same round-5 hoist as the dense kernel; measured there).
+        ns, legal = model.jax_step(val_of, f, a, b)
+        row = (ns[:, None] == val_of[None, :]) & legal[:, None]  # [S, S']
+        T = jnp.where(upd[:, None, None], row[None], T)
         slot_open = jnp.where(upd, True, slot_open)
         dirty = dirty | is_open
 
+        Te = (T & slot_open[:, None, None]).astype(jnp.float32)
+
         def sweep(F):
             for w in range(W):
-                F = expand_w(w, F, val_of, slot_f, slot_a, slot_b,
-                             slot_open)
+                F = expand_w(w, F, Te)
             return F
 
         F = _closure_fixpoint(W, sweep, F, is_force & dirty)
@@ -233,7 +234,7 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
         F_forced, _ = lax.switch(slot_w, force_branches, F)
         F = jnp.where(is_force, F_forced, F)
         slot_open = slot_open & ~(onehot & is_force)
-        return (F, slot_f, slot_a, slot_b, slot_open, dirty, val_of), None
+        return (F, T, slot_open, dirty, val_of), None
 
     def run_one(events, val_of, seed_mask, seed_state):
         # Seeded frontier; a dead seed (mask < 0) contributes nothing.
@@ -241,8 +242,7 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
              (jnp.arange(S)[None, :] == seed_state) & (seed_mask >= 0))
         carry = (
             F,
-            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
-            jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
+            jnp.zeros((W, S, S), bool), jnp.zeros((W,), bool),
             jnp.bool_(False), val_of,
         )
         carry, _ = lax.scan(scan_step, carry, events,

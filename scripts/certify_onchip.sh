@@ -57,6 +57,7 @@ echo "== 4/7 interleaved engine + unroll A/Bs"
 # reason.
 python scripts/ab_pallas.py 2>&1 | tee "$out/ab_pallas.log"
 python scripts/ab_unroll.py 2>&1 | tee "$out/ab_unroll.log"
+python scripts/ab_merge_long.py 2>&1 | tee "$out/ab_merge_long.log"
 
 echo "== 5/7 routing calibration (per-shape lower bounds) + unroll sweep"
 # Treat recommendations as LOWER bounds: host-routed small groups
