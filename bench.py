@@ -94,6 +94,7 @@ _EMITTED: list[dict] = []  # everything printed, for artifact persistence
 def emit(payload: dict) -> None:
     _EMITTED.append(payload)
     print(json.dumps(payload), flush=True)
+    beat()  # every emitted row is forward progress (watchdog)
 
 
 def persist_artifact(config: str) -> None:
@@ -140,6 +141,82 @@ def fail(msg: str, **extra) -> None:
           "vs_baseline": 0.0, "error": msg, **extra})
 
 
+# ---- mid-run wedge watchdog -------------------------------------------
+# The start-time probe and the init-failure re-exec cover a tunnel that
+# is down BEFORE the first kernel runs. The 2026-07-31 session hit the
+# third mode: the backend initializes, benches run, and then the tunnel
+# silently wedges MID-RUN — the blocking device read never returns and
+# no exception ever surfaces (a suite run sat >30 min at 0 CPU). The
+# watchdog re-execs on CPU when no progress heartbeat lands for
+# WATCHDOG_GAP_S; the gap comfortably exceeds the slowest legitimate
+# inter-beat span (CPU suite config-1 rep ≈ 67 s, cold XLA compile
+# ≈ 40 s, config-3 cluster recording beats per phase).
+
+WATCHDOG_GAP_S = float(os.environ.get("JGRAFT_BENCH_WATCHDOG_S", "300"))
+_last_beat = time.monotonic()
+
+#: Best-effort teardown hooks for resources that would otherwise outlive
+#: an os.execve/os._exit escape (the watchdog cannot unwind `finally`
+#: blocks on the wedged main thread — notably config 3's live native
+#: cluster, whose 5 server processes survive an exec as orphans).
+_CLEANUP: list = []
+
+
+def beat() -> None:
+    """Mark forward progress (called between reps/configs/phases)."""
+    global _last_beat
+    _last_beat = time.monotonic()
+
+
+def _already_on_cpu() -> bool:
+    """True when this process is already running the CPU fallback —
+    via the re-exec env pins OR the in-process pin_cpu() degrade paths
+    (probe-window failure / JAX_PLATFORMS=cpu), which set no env var."""
+    if (os.environ.get("JGRAFT_BENCH_PLATFORM") == "cpu"
+            or os.environ.get("JGRAFT_BENCH_DEGRADED")):
+        return True
+    try:
+        import jax
+
+        return (jax.config.jax_platforms or "") == "cpu"
+    except Exception:  # noqa: BLE001 — conservative: assume not pinned
+        return False
+
+
+def _run_cleanups() -> None:
+    for fn in list(_CLEANUP):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — crash-path best effort
+            pass
+
+
+def _start_watchdog() -> None:
+    import threading
+
+    def loop():
+        while True:
+            time.sleep(15)
+            if time.monotonic() - _last_beat <= WATCHDOG_GAP_S:
+                continue
+            if _already_on_cpu():
+                # Wedged ON CPU — nothing to degrade to; die loudly
+                # rather than hang the driver (the JSON error line is
+                # the artifact, plus any on-chip rows gathered before
+                # the wedge).
+                fail(f"no progress for {WATCHDOG_GAP_S:.0f}s on the CPU "
+                     "fallback — host wedged, giving up")
+                persist_artifact("partial_wedge")
+                _run_cleanups()
+                os._exit(3)
+            _reexec_on_cpu(RuntimeError(
+                f"no progress for {WATCHDOG_GAP_S:.0f}s — tunnel wedged "
+                "mid-run (backend up, device reads never returning)"))
+
+    threading.Thread(target=loop, daemon=True,
+                     name="bench-watchdog").start()
+
+
 def best_of(fn, profile_dir: str | None = None):
     """Run `fn` JGRAFT_BENCH_REPS times (default 3, floor 1) and return
     (best_result, [wall_s...]) by the first tuple element — or by the
@@ -164,6 +241,7 @@ def best_of(fn, profile_dir: str | None = None):
             r = fn()
             wall = time.perf_counter() - t0
         results.append((r, r[0] if isinstance(r, tuple) else wall))
+        beat()  # a completed rep is forward progress (watchdog)
     best, _ = min(results, key=lambda p: p[1])
     return best, [w for _, w in results]  # raw; emit rounds for display
 
@@ -268,6 +346,7 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
 
     run()  # warm-up: compile
+    beat()
     (dt, dt_pack, dt_kernel, n_valid, n_unknown), rep_times = best_of(
         run, profile_dir=os.environ.get("JGRAFT_PROFILE_DIR"))
 
@@ -347,6 +426,7 @@ def run_suite(platform_note: str) -> None:
         # window) kernel-cache entry and the timed run would pay the
         # multi-second XLA compile.
         check_histories(hists, model, algorithm="jax")
+        beat()
         # Best-of-3 like the north-star bench: single-shot suite rows
         # measured the tunnel's mood (config 4 read 3.08 hist/s in the
         # same session a warm in-process A/B measured 9.5).
@@ -383,12 +463,14 @@ def run_suite(platform_note: str) -> None:
     run_dir = _record_real_run(min_keys=sz(512, 16),
                                time_limit=max(8.0, 90.0 * scale))
     record_dt = time.perf_counter() - t0
+    beat()
     from jepsen_jgroups_raft_tpu.checker.recorded import check_recorded
     # auto: the product path — on-device kernels plus sound CPU
     # escalation for the timeout-polluted keys whose windows outgrow the
     # kernels (partition nemesis histories produce a few). Warm once
     # (compile), then best-of-3 like every other row.
     check_recorded([run_dir], algorithm="auto")
+    beat()
     summary, times = best_of(
         lambda: check_recorded([run_dir], algorithm="auto"))
     dt = min(times)
@@ -442,10 +524,15 @@ def _record_real_run(min_keys: int, time_limit: float = 90.0):
     }
     test = compose_test(opts, db=LocalRaftDB(cluster, seed=9),
                         net=BlockNet(cluster), seed=9)
+    # Watchdog escape hatch: os.execve/os._exit cannot unwind the
+    # finally below, so the cluster also registers for crash-path
+    # teardown (shutdown is idempotent).
+    _CLEANUP.append(cluster.shutdown)
     try:
         test = run_test(test)
     finally:
         cluster.shutdown()
+        _CLEANUP.remove(cluster.shutdown)
     return test["store_dir"]
 
 
@@ -497,6 +584,8 @@ def resolve_platform() -> str:
 
 def main() -> None:
     note = resolve_platform()
+    beat()
+    _start_watchdog()
     if degraded := os.environ.get("JGRAFT_BENCH_DEGRADED"):
         note += f" [degraded: first attempt failed: {degraded}]"
     if "--suite" in sys.argv:
@@ -528,6 +617,12 @@ def _reexec_on_cpu(e: BaseException) -> None:
     would turn the CPU fallback itself into an rc=124."""
     from jepsen_jgroups_raft_tpu.platform import cpu_subprocess_env
 
+    # The exec wipes this process's state: save any on-chip rows already
+    # measured (persist_artifact no-ops when none exist — the common
+    # init-failure case) and tear down resources an exec cannot unwind
+    # (live native clusters; their processes would survive as orphans).
+    persist_artifact("partial_wedge")
+    _run_cleanups()
     env = cpu_subprocess_env()
     env["JGRAFT_BENCH_PLATFORM"] = "cpu"
     env["JGRAFT_BENCH_DEGRADED"] = f"{type(e).__name__}: {e}"[:300]
@@ -540,9 +635,7 @@ if __name__ == "__main__":
     except (KeyboardInterrupt, SystemExit):
         raise  # an interrupted run must not masquerade as a measured rc=0
     except Exception as e:  # noqa: BLE001 — the artifact must exist
-        already_cpu = (os.environ.get("JGRAFT_BENCH_PLATFORM") == "cpu"
-                       or os.environ.get("JGRAFT_BENCH_DEGRADED"))
-        if _is_backend_init_failure(e) and not already_cpu:
+        if _is_backend_init_failure(e) and not _already_on_cpu():
             _reexec_on_cpu(e)  # does not return
         fail(f"{type(e).__name__}: {e}",
              traceback=traceback.format_exc(limit=20))
