@@ -89,6 +89,14 @@ def _route_group_to_host(n_rows: int, n_events: int) -> bool:
         return False
     if mode == "cpu":
         return True
+    if n_events > MERGE_MAX_EVENTS:
+        # LONG groups are depth-bound, not launch-bound: the B·E cell
+        # gate (calibrated on config-3's tiny uniform-window batches)
+        # undercounts their kernel work by the 2^W·S factor, and a
+        # small merged cluster (e.g. 2×20k events = 40k cells) would
+        # otherwise land on the throughput-bound host — the exact
+        # placement the merged-launch policy measured 2.2× slower.
+        return False
     import jax
 
     if jax.default_backend() != "tpu":

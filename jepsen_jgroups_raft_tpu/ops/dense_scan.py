@@ -142,6 +142,21 @@ MERGE_MAX_EVENTS = 4096
 MERGE_LONG_MAX_SPREAD = 3
 
 
+def _merge_all_groups() -> bool:
+    """Experimental (JGRAFT_MERGE_ALL=1, off by default): extend the
+    merged-launch policy to SHORT histories too — one spread-capped
+    cluster per window neighborhood instead of per-window launches.
+    The same serial-depth argument applies (the north-star batch's 4
+    window groups scan ~8200 sequential steps where one W=8 launch
+    would scan ~2050 at ~1.9× the per-step cell work), but whether the
+    chip is latency- or throughput-bound at B≈1000 × [256,4] frontiers
+    is an open on-chip measurement (scripts/ab_merge_long.py --all);
+    short histories also lack the uniform event lengths that make
+    merging free for the long configs, so this stays opt-in until the
+    chip says otherwise."""
+    return os.environ.get("JGRAFT_MERGE_ALL", "0") == "1"
+
+
 def _merge_long_groups() -> bool:
     """Round-5 policy REVERSAL of per-window launches for LONG
     histories. Launches serialize on a single TPU core, so per-window
@@ -157,8 +172,17 @@ def _merge_long_groups() -> bool:
     comparison, the methodology the tunneled chip later proved
     unusable: identical benches span 249-677 hist/s across processes.)
     The width term is real, so merging is bounded by
-    MERGE_LONG_MAX_SPREAD. JGRAFT_MERGE_LONG=0 restores per-window."""
-    return os.environ.get("JGRAFT_MERGE_LONG", "1") == "1"
+    MERGE_LONG_MAX_SPREAD — and the default is TPU-ONLY: the host mesh
+    is throughput-bound at these widths, so the same merge that wins
+    1.36× on the chip measured config-4 CPU at 0.61 hist/s vs 1.34
+    per-window (2026-07-31 CPU suite) — the segment-routing asymmetry
+    again. JGRAFT_MERGE_LONG=1 forces merged anywhere, =0 forbids."""
+    forced = os.environ.get("JGRAFT_MERGE_LONG")
+    if forced is not None:
+        return forced == "1"
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 
 def _pad_domains(domains, idxs):
@@ -235,16 +259,22 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
         return (pending, DensePlan("domain", w_eff, S, val_of))
 
     merge_long = _merge_long_groups()
+    # JGRAFT_MERGE_LONG=0 is the absolute off-switch: it forbids the
+    # experimental MERGE_ALL mode too (an operator pinning =0 on a host
+    # must never get merged launches by adding the experiment knob).
+    merge_all = (_merge_all_groups()
+                 and os.environ.get("JGRAFT_MERGE_LONG") != "0")
     for kind in ("domain", "mask"):
         windows = sorted(w for k, w in buckets if k == kind)
-        if merge_long:
+        if merge_long or merge_all:
             # Merge long histories of this kind into window-proximate
             # cluster launches (see _merge_long_groups): shorts keep
             # the per-window path below (merging a short history into
             # a long launch would pad its event stream E_long/E_short×,
             # which no launch saving repays).
             longs = set(i for w in windows for i in buckets[(kind, w)]
-                        if encs[i].n_events > MERGE_MAX_EVENTS)
+                        if merge_all
+                        or encs[i].n_events > MERGE_MAX_EVENTS)
             if longs:
                 for w in windows:
                     buckets[(kind, w)] = [

@@ -26,8 +26,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--reps", type=int, default=5)
-    ap.add_argument("--n-histories", type=int, default=16)
-    ap.add_argument("--n-ops", type=int, default=10_000)
+    ap.add_argument("--n-histories", type=int, default=None,
+                    help="default: 16 (config-4 mode) / 1000 (--all)")
+    ap.add_argument("--n-ops", type=int, default=None,
+                    help="default: 10000 (config-4 mode) / 1000 (--all)")
+    ap.add_argument("--all", action="store_true",
+                    help="A/B JGRAFT_MERGE_ALL on the north-star shape "
+                         "(short histories; per-window vs one merged "
+                         "spread-capped cluster) instead of the long-"
+                         "history config-4 shape")
     args = ap.parse_args()
 
     import random
@@ -38,12 +45,22 @@ def main() -> None:
 
     rng = random.Random(3)
     model = CasRegister()
-    hists = [random_valid_history(rng, "register", n_ops=args.n_ops,
-                                  n_procs=5, crash_p=0.02, max_crashes=4)
-             for _ in range(args.n_histories)]
+    if args.all:
+        defaults, crash_p, max_crashes = (1000, 1000), 0.05, 3
+        knob = "JGRAFT_MERGE_ALL"
+    else:
+        defaults, crash_p, max_crashes = (16, 10_000), 0.02, 4
+        knob = "JGRAFT_MERGE_LONG"
+    n_hist = args.n_histories if args.n_histories else defaults[0]
+    n_ops = args.n_ops if args.n_ops else defaults[1]
+    hists = [random_valid_history(rng, "register", n_ops=n_ops,
+                                  n_procs=5, crash_p=crash_p,
+                                  max_crashes=max_crashes)
+             for _ in range(n_hist)]
+    args.n_histories = n_hist
 
     def run(merged: bool):
-        os.environ["JGRAFT_MERGE_LONG"] = "1" if merged else "0"
+        os.environ[knob] = "1" if merged else "0"
         t0 = time.perf_counter()
         rs = check_histories(hists, model, algorithm="jax")
         dt = time.perf_counter() - t0
@@ -59,7 +76,7 @@ def main() -> None:
     for _ in range(args.reps):              # interleaved
         for name, m in variants.items():
             times[name].append(run(m)[0])
-    os.environ.pop("JGRAFT_MERGE_LONG", None)
+    os.environ.pop(knob, None)
     for name, ts in times.items():
         print({"variant": name, "min_s": round(min(ts), 3),
                "median_s": round(statistics.median(ts), 3),
