@@ -268,19 +268,31 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
         windows = sorted(w for k, w in buckets if k == kind)
         if merge_long or merge_all:
             # Merge long histories of this kind into window-proximate
-            # cluster launches (see _merge_long_groups): shorts keep
-            # the per-window path below (merging a short history into
-            # a long launch would pad its event stream E_long/E_short×,
-            # which no launch saving repays).
-            longs = set(i for w in windows for i in buckets[(kind, w)]
-                        if merge_all
-                        or encs[i].n_events > MERGE_MAX_EVENTS)
-            if longs:
+            # cluster launches (see _merge_long_groups). Under the
+            # experimental MERGE_ALL, SHORT histories cluster too — but
+            # in a SEPARATE pool per event-length class: merging a
+            # short history into a long launch would pad its event
+            # stream E_long/E_short×, which no launch saving repays.
+            # Shorts not pooled here keep the per-window path below.
+            pools = []
+            long_pool = [i for w in windows for i in buckets[(kind, w)]
+                         if encs[i].n_events > MERGE_MAX_EVENTS]
+            if long_pool:
+                pools.append(long_pool)
+            if merge_all:
+                short_pool = [i for w in windows
+                              for i in buckets[(kind, w)]
+                              if encs[i].n_events <= MERGE_MAX_EVENTS]
+                if short_pool:
+                    pools.append(short_pool)
+            pooled = set(i for p in pools for i in p)
+            if pooled:
                 for w in windows:
                     buckets[(kind, w)] = [
-                        i for i in buckets[(kind, w)] if i not in longs]
+                        i for i in buckets[(kind, w)] if i not in pooled]
                 windows = [w for w in windows if buckets[(kind, w)]]
-                by_w = sorted(longs, key=lambda i: encs[i].n_slots,
+            for pool in pools:
+                by_w = sorted(pool, key=lambda i: encs[i].n_slots,
                               reverse=True)
                 while by_w:
                     w_top = encs[by_w[0]].n_slots
@@ -296,10 +308,10 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
                     # fits: per-history eligibility used its own W and
                     # unpadded S, and pow2 padding cannot double past
                     # the cap at these sizes.)
-                    take, rest_long, s_run = [], [], 1
+                    take, rest_pool, s_run = [], [], 1
                     for i in by_w:
                         if encs[i].n_slots < cut:
-                            rest_long.append(i)
+                            rest_pool.append(i)
                             continue
                         s_new = max(s_run, len(domains[i])
                                     if kind == "domain" else 1)
@@ -307,11 +319,11 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
                         while s_pad < s_new:
                             s_pad *= 2
                         if take and (1 << w_top) * s_pad > DENSE_MAX_CELLS:
-                            rest_long.append(i)
+                            rest_pool.append(i)
                             continue
                         take.append(i)
                         s_run = s_new
-                    by_w = rest_long
+                    by_w = rest_pool
                     g = flush(kind, take)
                     if g is not None:
                         groups.append(g)
@@ -409,7 +421,39 @@ def _make_force_branches(bit_table: np.ndarray, W: int, S: int):
     return [_mk(w) for w in range(W)]
 
 
-def make_dense_history_checker(model, n_slots: int, n_states: int):
+def hoist_transitions() -> bool:
+    """Whether the DOMAIN kernel keeps transition matrices in the scan
+    carry (refreshed once per OPEN) instead of re-deriving them from
+    model.jax_step inside every closure sweep. (The segment kernel
+    stays carry-hoisted unconditionally: its auto route is TPU-only —
+    where hoisted is the measured winner — and CPU reaches it only via
+    the JGRAFT_SEGMENT=1 correctness soaks. The mask kernel's legality
+    hoist won on BOTH platforms and has no style switch.) Backend-keyed
+    at build time, measured 2026-07-31 both ways on idle hardware:
+
+      * v5e: hoisted wins every affected config (config 4 merged
+        2.415 → 2.15-2.33 s, config 5 segmented 4.7 → 3.96 s) — per
+        step, fusion count is the wall and the hoist removes W
+        jax_step+T builds from each sweep iteration.
+      * CPU host: hoisted LOSES big at small batch (config 5 B=1
+        monolithic: 3.6-4.1 s register-style vs 7.1-7.5 s hoisted,
+        same host back-to-back) — the compiled scalar loop paid
+        per-step carry traffic ([W,S,S] T threading + per-event row
+        build) that the guarded closure never executed.
+
+    JGRAFT_HOIST=1/0 forces either style (ablations); kernel caches
+    key on the resolved value, so the in-process CPU degrade path
+    rebuilds correctly after pin_cpu()."""
+    forced = os.environ.get("JGRAFT_HOIST")
+    if forced is not None:
+        return forced == "1"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def make_dense_history_checker(model, n_slots: int, n_states: int,
+                               hoist: Optional[bool] = None):
     """Build fn(events [E,5], val_of [S]) -> (valid, overflow=False).
 
     Step shape note (round-5): a gather-based rewrite of this kernel
@@ -419,50 +463,92 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
     session) — TPU gathers at these tiny shapes cost more than the
     fusion count they save, which is exactly why the design invariant
     in the module docstring says "no sort, no scatter, no gather".
-    The one salvaged piece: transition matrices live in the carry
-    (refreshed once per OPEN), so the closure sweeps stopped
-    re-evaluating model.jax_step W times per iteration."""
+    The transition-matrix placement (carry-hoisted vs in-sweep) is
+    backend-keyed: see hoist_transitions()."""
+    if hoist is None:
+        hoist = hoist_transitions()
     W, S = int(n_slots), int(n_states)
     M = 1 << W
     slot_ids = jnp.arange(W, dtype=jnp.int32)
     bit_table = _bit_table(M, W)
     force_branches = _make_force_branches(bit_table, W, S)
 
-    def expand_w(w, F, Te):
-        """One slot's flow: configs without bit w linearize op w."""
+    def expand_w(w, F, T_w):
+        """One slot's flow: configs without bit w linearize op w
+        through its [S, S'] transition matrix."""
         Fb = F.reshape(M >> (w + 1), 2, 1 << w, S)
         src = Fb[:, 0].reshape(-1, S).astype(jnp.float32)
-        contrib = (src @ Te[w]).reshape(M >> (w + 1), 1 << w, S) > 0
+        contrib = (src @ T_w).reshape(M >> (w + 1), 1 << w, S) > 0
         return jnp.concatenate(
             [Fb[:, :1], (Fb[:, 1] | contrib)[:, None]], axis=1
         ).reshape(M, S)
 
+    # The two carry styles (hoist_transitions) differ ONLY in how a
+    # slot's transition matrix is produced — everything else (OPEN
+    # latch, dirty gating, closure, FORCE kill+recycle, ok accounting)
+    # is the shared scan skeleton below, so a semantic fix can never
+    # apply to one style and miss the other.
+    if hoist:
+        extra0 = (jnp.zeros((W, S, S), bool),)
+
+        def style_update(extra, upd, f, a, b, val_of):
+            (T,) = extra
+            ns, legal = model.jax_step(val_of, f, a, b)
+            row = (ns[:, None] == val_of[None, :]) & legal[:, None]
+            return (jnp.where(upd[:, None, None], row[None], T),)
+
+        def style_sweep(extra, slot_open, val_of):
+            (T,) = extra
+            Te = (T & slot_open[:, None, None]).astype(jnp.float32)
+
+            def sweep(F):  # static unroll; expansions chain w ascending
+                for w in range(W):
+                    F = expand_w(w, F, Te[w])
+                return F
+
+            return sweep
+    else:
+        extra0 = (jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
+                  jnp.zeros((W,), jnp.int32))
+
+        def style_update(extra, upd, f, a, b, val_of):
+            sf, sa, sb = extra
+            return (jnp.where(upd, f, sf), jnp.where(upd, a, sa),
+                    jnp.where(upd, b, sb))
+
+        def style_sweep(extra, slot_open, val_of):
+            sf, sa, sb = extra
+
+            def sweep(F):  # static unroll; expansions chain w ascending
+                for w in range(W):
+                    ns, legal = model.jax_step(val_of, sf[w], sa[w],
+                                               sb[w])
+                    T_w = ((ns[:, None] == val_of[None, :]) &
+                           legal[:, None] &
+                           slot_open[w]).astype(jnp.float32)
+                    F = expand_w(w, F, T_w)
+                return F
+
+            return sweep
+
     def scan_step(carry, ev):
-        F, T, slot_open, ok, dirty, val_of = carry
+        F, extra, slot_open, ok, dirty, val_of = carry
         etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
         is_open = etype == EV_OPEN
         is_force = etype == EV_FORCE
 
         onehot = slot_ids == slot
         upd = onehot & is_open
-        ns, legal = model.jax_step(val_of, f, a, b)
-        row = (ns[:, None] == val_of[None, :]) & legal[:, None]  # [S, S']
-        T = jnp.where(upd[:, None, None], row[None], T)
+        extra = style_update(extra, upd, f, a, b, val_of)
         slot_open = jnp.where(upd, True, slot_open)
         dirty = dirty | is_open
-
-        Te = (T & slot_open[:, None, None]).astype(jnp.float32)
-
-        def sweep(F):  # static unroll; expansions chain w ascending
-            for w in range(W):
-                F = expand_w(w, F, Te)
-            return F
 
         # Closure only when an OPEN happened since the last one: a closed
         # frontier stays closed under FORCE kill+clear (extensions of a
         # surviving config are supersets, so they survived and cleared
         # too), so back-to-back completions skip the sweeps entirely.
-        F = _closure_fixpoint(W, sweep, F, is_force & dirty)
+        F = _closure_fixpoint(W, style_sweep(extra, slot_open, val_of),
+                              F, is_force & dirty)
         dirty = dirty & ~is_force
 
         slot_w = jnp.clip(slot, 0, W - 1)
@@ -470,13 +556,12 @@ def make_dense_history_checker(model, n_slots: int, n_states: int):
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~(onehot & is_force)
-        return (F, T, slot_open, ok, dirty, val_of), None
+        return (F, extra, slot_open, ok, dirty, val_of), None
 
     def check(events, val_of):
         F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
         carry = (
-            F,
-            jnp.zeros((W, S, S), bool), jnp.zeros((W,), bool),
+            F, extra0, jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False), val_of,
         )
         carry, _ = lax.scan(scan_step, carry, events,
@@ -600,11 +685,12 @@ _KERNEL_CACHE: dict = {}
 def make_dense_batch_checker(model, kind: str, n_slots: int, n_states: int,
                              jit: bool = True):
     """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B])."""
-    # scan_unroll() keys the cache: the build closures resolve it at
-    # trace time, so an env/backend change mid-process (ablation sweeps,
-    # CPU degrade) must map to a distinct compiled kernel.
+    # scan_unroll() and hoist_transitions() key the cache: the build
+    # closures resolve them at trace time, so an env/backend change
+    # mid-process (ablation sweeps, CPU degrade after pin_cpu) must map
+    # to a distinct compiled kernel.
     key = (*model.cache_key(), kind, int(n_slots), int(n_states), jit,
-           scan_unroll())
+           scan_unroll(), hoist_transitions())
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         single = make_dense_single_checker(model, kind, n_slots, n_states)

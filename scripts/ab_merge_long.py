@@ -48,6 +48,11 @@ def main() -> None:
     if args.all:
         defaults, crash_p, max_crashes = (1000, 1000), 0.05, 3
         knob = "JGRAFT_MERGE_ALL"
+        # An inherited JGRAFT_MERGE_LONG=0 is the absolute off-switch
+        # that would silently disable BOTH variants of this A/B.
+        if os.environ.pop("JGRAFT_MERGE_LONG", None) == "0":
+            print("# note: clearing inherited JGRAFT_MERGE_LONG=0 for "
+                  "the --all A/B (it forbids MERGE_ALL outright)")
     else:
         defaults, crash_p, max_crashes = (16, 10_000), 0.02, 4
         knob = "JGRAFT_MERGE_LONG"

@@ -440,3 +440,35 @@ def test_merge_long_verdict_parity(monkeypatch):
     assert verdicts["0"] == verdicts["1"]
     assert verdicts["1"][1] is False
     assert verdicts["1"][0] is True
+
+
+def test_hoist_styles_verdict_parity(monkeypatch):
+    """The carry-hoisted and register-style domain kernels are the same
+    search (hoist_transitions is a backend-keyed perf trade): verdicts
+    must match on valid, invalid, and crashed-op histories."""
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+
+    m = CasRegister()
+    rng = random.Random(13)
+    hs = [random_valid_history(rng, "register", n_ops=300, n_procs=p,
+                               crash_p=0.1, max_crashes=3)
+          for p in (2, 3, 5)]
+    bad = History()
+    flipped = False
+    for op in hs[0]:
+        if not flipped and op.type == OK and op.f == "read" \
+                and op.value is not None:
+            bad.append(Op(op.process, op.type, op.f, op.value + 50))
+            flipped = True
+        else:
+            bad.append(op)
+    assert flipped
+    hs.append(bad)
+    verdicts = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("JGRAFT_HOIST", flag)
+        rs = check_histories(hs, m, algorithm="jax")
+        verdicts[flag] = [r["valid?"] for r in rs]
+    assert verdicts["0"] == verdicts["1"]
+    assert verdicts["1"][3] is False
+    assert verdicts["1"][0] is True
