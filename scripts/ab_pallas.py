@@ -60,10 +60,15 @@ def main() -> None:
         n = sum(int(np.asarray(ok)[:B].sum()) for (ok, _), B in outs)
         return time.perf_counter() - t0, n
 
+    import jax
+
+    interpret = jax.default_backend() != "tpu"  # smoke-testable off-chip
+
     def run_pallas():
         t0 = time.perf_counter()
         outs = [(make_pallas_batch_checker(model, p.n_slots, p.n_states,
-                                           ev.shape[1])(ev, vf), B)
+                                           ev.shape[1],
+                                           interpret=interpret)(ev, vf), B)
                 for p, ev, vf, B in padded]
         n = sum(int(np.asarray(ok)[:B].sum()) for (ok, _), B in outs)
         return time.perf_counter() - t0, n

@@ -58,6 +58,12 @@ echo "== 4/7 interleaved engine + unroll A/Bs"
 python scripts/ab_pallas.py 2>&1 | tee "$out/ab_pallas.log"
 python scripts/ab_unroll.py 2>&1 | tee "$out/ab_unroll.log"
 python scripts/ab_merge_long.py 2>&1 | tee "$out/ab_merge_long.log"
+# Open on-chip questions from the 2026-07-31 CPU-side work: does
+# clustering SHORT histories win on the chip (the north-star batch's 4
+# serial window groups vs one W=8 launch), and does the backend-keyed
+# transition hoist hold on the production path?
+python scripts/ab_merge_long.py --all 2>&1 | tee "$out/ab_merge_all.log"
+JGRAFT_HOIST=0 python bench.py 2>&1 | tee "$out/bench_hoist_off.log"
 
 echo "== 5/7 routing calibration (per-shape lower bounds) + unroll sweep"
 # Treat recommendations as LOWER bounds: host-routed small groups
