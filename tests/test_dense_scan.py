@@ -472,3 +472,39 @@ def test_hoist_styles_verdict_parity(monkeypatch):
     assert verdicts["0"] == verdicts["1"]
     assert verdicts["1"][3] is False
     assert verdicts["1"][0] is True
+
+
+def test_merge_all_pools_by_event_length(monkeypatch):
+    """JGRAFT_MERGE_ALL clusters short histories in their OWN pool: a
+    short history must never ride in a long launch (its event stream
+    would pad E_long/E_short x), even when windows are proximate."""
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.ops.dense_scan import (MERGE_MAX_EVENTS,
+                                                        dense_plans_grouped)
+
+    monkeypatch.setenv("JGRAFT_MERGE_ALL", "1")
+    monkeypatch.delenv("JGRAFT_MERGE_LONG", raising=False)
+    m = CasRegister()
+    rng = random.Random(21)
+    long_encs = [encode_history(
+        random_valid_history(rng, "register", n_ops=MERGE_MAX_EVENTS + 256,
+                             n_procs=5, crash_p=0.02, max_crashes=3), m)
+        for _ in range(3)]
+    short_encs = [encode_history(
+        random_valid_history(rng, "register", n_ops=40, n_procs=5,
+                             crash_p=0.05, max_crashes=3), m)
+        for _ in range(6)]
+    encs = long_encs + short_encs
+    is_long = [e.n_events > MERGE_MAX_EVENTS for e in encs]
+    assert all(is_long[:3]) and not any(is_long[3:])
+    groups, rest = dense_plans_grouped(m, encs)
+    assert not rest
+    for idxs, _ in groups:
+        kinds = {is_long[i] for i in idxs}
+        assert len(kinds) == 1, f"mixed-length cluster: {idxs}"
+    # And the shorts really did cluster across windows (the experiment).
+    short_groups = [idxs for idxs, _ in groups if not is_long[idxs[0]]]
+    ws = sorted(encs[i].n_slots for g in short_groups for i in g)
+    if len({encs[i].n_slots for i in range(3, 9)}) > 1:
+        assert any(len({encs[i].n_slots for i in g}) > 1
+                   for g in short_groups)
