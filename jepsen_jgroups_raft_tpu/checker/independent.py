@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional
 
-from ..history.ops import History, Op
+from ..history.ops import History
 from ..models.base import Model
 from .base import Checker, merge_valid
 from .linearizable import check_histories

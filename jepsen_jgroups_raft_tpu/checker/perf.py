@@ -14,7 +14,7 @@ import math
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from ..history.ops import INFO, INVOKE, NEMESIS, OK, History
+from ..history.ops import INFO, OK, History
 from .base import Checker
 
 

@@ -23,7 +23,6 @@ import time
 from pathlib import Path
 from typing import Optional, Sequence
 
-from ..history.ops import History
 from ..models import CasRegister, Counter
 from ..models.base import Model
 from ..models.leader import MajorityLeaderModel

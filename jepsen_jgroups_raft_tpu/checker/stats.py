@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from collections import Counter as TallyCounter
 
-from ..history.ops import FAIL, INFO, INVOKE, NEMESIS, OK, History
+from ..history.ops import FAIL, INFO, INVOKE, OK, History
 from .base import Checker
 
 

@@ -24,7 +24,7 @@ changes nothing the model can observe.
 from __future__ import annotations
 
 import socket
-from typing import Iterable, Optional, Tuple
+from typing import Iterable, Tuple
 
 from ..history.ops import FAIL, INFO, Op
 

@@ -176,7 +176,8 @@ def run_test(test: dict) -> dict:
                         try:
                             client.close(test)
                         except Exception:
-                            pass
+                            LOG.debug("worker %d: close after info op "
+                                      "failed", i, exc_info=True)
                         try:
                             client = proto.open(test, node)
                             client.setup(test)
@@ -256,7 +257,8 @@ def run_test(test: dict) -> dict:
                 try:
                     logs[n] = db.log_files(test, n)
                 except Exception:
-                    pass
+                    LOG.warning("log collection failed for %s", n,
+                                exc_info=True)
         test["log_files"] = logs
         with ThreadPoolExecutor(len(test["nodes"])) as ex:
             list(ex.map(lambda n: db.teardown(test, n), test["nodes"]))

@@ -12,7 +12,6 @@ reference's `lein run serve` (raft.clj:98-101).
 from __future__ import annotations
 
 import json
-import os
 import time
 from pathlib import Path
 from typing import Union

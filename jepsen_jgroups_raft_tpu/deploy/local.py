@@ -22,18 +22,17 @@ root. For real multi-host clusters deploy.ssh provides the iptables path.
 from __future__ import annotations
 
 import os
-import random
 import signal
 import socket
 import subprocess
 import tempfile
 import time
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
 from ..core.db import Net
 from ..native import SERVER_BIN, ensure_built
-from ..native.client import NativeConn, make_conn_factory
+from ..native.client import CONN_ERRORS, NativeConn, make_conn_factory
 from .base import RaftDB
 
 
@@ -207,8 +206,8 @@ class LocalCluster:
         try:
             conn = self.admin(name, timeout)
             return conn.probe()
-        except Exception:
-            return None
+        except CONN_ERRORS:
+            return None  # unreachable/restarting node: no local view
         finally:
             if conn is not None:
                 conn.close()
@@ -255,12 +254,12 @@ class BlockNet(Net):
                 continue
             try:
                 conn = self.cluster.admin(node)
-            except Exception:
+            except CONN_ERRORS:
                 continue  # dead node: already cut off
             try:
                 conn.admin_block(enemies)
-            except Exception:
-                pass
+            except CONN_ERRORS:
+                pass  # mid-fault node: its transport is already cut
             finally:
                 conn.close()
 
@@ -270,11 +269,11 @@ class BlockNet(Net):
         for node in sorted(nodes):
             try:
                 conn = self.cluster.admin(node)
-            except Exception:
-                continue
+            except CONN_ERRORS:
+                continue  # dead node: nothing to heal
             try:
                 conn.admin_unblock()
-            except Exception:
-                pass
+            except CONN_ERRORS:
+                pass  # node died mid-heal; restart clears blocks
             finally:
                 conn.close()

@@ -17,7 +17,6 @@ reference's hardcoded endpoint (server.clj:124,143,160), peers on 9100.
 
 from __future__ import annotations
 
-import random
 import shlex
 import subprocess
 from pathlib import Path
@@ -25,7 +24,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..core.db import Net
 from ..native import SERVER_BIN, ensure_built
-from ..native.client import NativeConn, make_conn_factory
+from ..native.client import CONN_ERRORS, NativeConn, make_conn_factory
 from .base import RaftDB
 
 REMOTE_DIR = "/opt/raft"          # install dir (server.clj:25-32)
@@ -255,8 +254,8 @@ class RemoteRaftCluster:
         try:
             conn = NativeConn(name, self.client_port, timeout)
             return conn.probe()
-        except Exception:
-            return None
+        except CONN_ERRORS:
+            return None  # unreachable/rebooting node: no local view
         finally:
             if conn is not None:
                 conn.close()
@@ -275,8 +274,8 @@ class RemoteRaftCluster:
         for n in self.nodes:
             try:
                 self.kill_node(n)
-            except Exception:
-                pass
+            except (OSError, subprocess.SubprocessError):
+                pass  # ssh unreachable/timed out: node is dying anyway
 
 
 class RemoteRaftDB(RaftDB):
@@ -321,7 +320,7 @@ class IptablesNet(Net):
             for cmd in iptables_partition_cmds(enemies):
                 try:
                     r.exec(cmd, check=False)
-                except Exception:
+                except (OSError, subprocess.SubprocessError):
                     pass  # dead node is already cut off
 
     def heal(self, test) -> None:
@@ -334,5 +333,5 @@ class IptablesNet(Net):
             for cmd in iptables_heal_cmds():
                 try:
                     r.exec(cmd, check=False)
-                except Exception:
-                    pass
+                except (OSError, subprocess.SubprocessError):
+                    pass  # unreachable node heals when it returns

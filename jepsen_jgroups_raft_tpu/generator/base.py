@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import logging
 import random
-from typing import Callable, Iterable, Optional, Sequence, Union
+from typing import Callable, Optional, Sequence
 
 LOG = logging.getLogger("jgraft.generator")
 

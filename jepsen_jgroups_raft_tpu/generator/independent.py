@@ -14,7 +14,7 @@ op() under the scheduler lock.
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, Optional
+from typing import Callable, Iterable, Iterator
 
 from .base import NEMESIS_THREAD, PENDING, Generator, to_gen
 

@@ -1,0 +1,21 @@
+"""graftlint: project-native static analysis (see ISSUE/doc).
+
+Three analyzers, one per repo-level invariant no generic linter knows:
+
+* :mod:`.taxonomy` — exception paths that record op outcomes must
+  respect the definite/indefinite taxonomy (client/errors.py), or the
+  linearizability checker is unsound.
+* :mod:`.jit_hygiene` — no host syncs / Python tracer branching /
+  recompile hazards inside jitted or Pallas-traced bodies; intentional
+  device→host hops in launch functions carry ``# lint: allow(host-sync)``.
+* :mod:`.lock_discipline` — ``// GUARDED_BY(mu)`` fields in
+  ``native/src`` are only touched under their mutex (or in
+  ``// REQUIRES(mu)`` helpers).
+
+CLI: ``python -m jepsen_jgroups_raft_tpu.lint [paths]`` —
+``scripts/lint.sh`` is the one-command gate (ruff → graftlint →
+``make -C native tidy``).
+"""
+
+from .base import Finding, SourceFile  # noqa: F401
+from .cli import main, run  # noqa: F401

@@ -1,0 +1,108 @@
+"""graftlint CLI: run the project-native analyzers over the repo.
+
+Usage::
+
+    python -m jepsen_jgroups_raft_tpu.lint [paths...]
+        [--rules taxonomy,jit,lock] [--list-rules]
+
+With no paths, lints the repo the package lives in (the self-hosting
+default `scripts/lint.sh` runs). Each analyzer applies only to its scan
+set when given a directory; an explicit single *file* argument is always
+analyzed by every requested analyzer that understands its language —
+that is what the seeded-violation tests (and quick one-file checks) use.
+
+Exit status: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List
+
+from . import jit_hygiene, lock_discipline, taxonomy
+from .base import Finding, collect_files, rel
+
+#: name → (module, suffixes)
+ANALYZERS = {
+    "taxonomy": (taxonomy, (".py",)),
+    "jit": (jit_hygiene, (".py",)),
+    "lock": (lock_discipline, (".h", ".cc")),
+}
+
+RULES = {
+    "taxonomy": ("taxonomy-bare-except-fail", "taxonomy-indefinite-fail",
+                 "taxonomy-silent-swallow"),
+    "jit": ("jit-host-sync", "jit-python-branch", "jit-recompile-hazard",
+            "host-sync"),
+    "lock": ("lock-guarded-field", "lock-unknown-mutex"),
+}
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def run(paths: List[str], rules: List[str]) -> List[Finding]:
+    root = repo_root()
+    explicit = {Path(p).resolve() for p in paths if Path(p).is_file()}
+    findings: List[Finding] = []
+    for name in rules:
+        mod, suffixes = ANALYZERS[name]
+        for f in collect_files(paths, suffixes):
+            relpath = rel(f, root)
+            if not (Path(f).resolve() in explicit or
+                    mod.applies_to(relpath)):
+                continue
+            for finding in mod.analyze_file(f):
+                findings.append(Finding(relpath, finding.line,
+                                        finding.rule, finding.message))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m jepsen_jgroups_raft_tpu.lint",
+        description="graftlint: checker-soundness, jit-hygiene and "
+                    "native lock-discipline analysis")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the repo)")
+    parser.add_argument("--rules", default="taxonomy,jit,lock",
+                        help="comma-separated analyzer subset")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for analyzer, rules in RULES.items():
+            for r in rules:
+                print(f"{analyzer}: {r}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    unknown = [r for r in rules if r not in ANALYZERS]
+    if unknown:
+        print(f"unknown analyzer(s): {', '.join(unknown)} "
+              f"(have: {', '.join(ANALYZERS)})", file=sys.stderr)
+        return 2
+
+    # A typo'd path must be a loud usage error, not a silent clean pass.
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [str(repo_root() / "jepsen_jgroups_raft_tpu"),
+                           str(repo_root() / "native" / "src")]
+    findings = run(paths, rules)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print(f"graftlint: clean ({', '.join(rules)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

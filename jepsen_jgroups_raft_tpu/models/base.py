@@ -26,7 +26,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-from ..history.ops import FAIL, INFO, NIL, OK, OpPair
+from ..history.ops import FAIL, NIL, OpPair
 
 INT32_MIN = -(2**31)
 INT32_MAX = 2**31 - 1

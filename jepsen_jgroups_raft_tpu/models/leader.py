@@ -12,11 +12,11 @@ sort observations by term and compare adjacent same-term leaders.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
-from ..history.ops import INVOKE, OK, History, Op
+from ..history.ops import INVOKE, OK, History
 
 
 class LeaderModel:

@@ -39,6 +39,16 @@ class ServerError(Exception):
     Response — data/Response.java:42-67 semantics)."""
 
 
+#: Everything a NativeConn call raises for node-side reasons: OSError
+#: covers the whole indefinite family (ClientTimeout ⊂ TimeoutError,
+#: ConnectFailed ⊂ ConnectionError, SocketBroken — all OSError
+#: subclasses); NotLeader and ServerError are the definite rejections.
+#: Callers that probe/clean up catch THIS, not Exception: a broad catch
+#: would also swallow harness bugs, which the graftlint taxonomy rule
+#: (taxonomy-silent-swallow) flags.
+CONN_ERRORS = (OSError, NotLeader, ServerError)
+
+
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -151,7 +161,9 @@ class NativeConn:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except (OSError, AttributeError, TypeError):
+            # interpreter-shutdown teardown: ctypes globals may already
+            # be gone; anything else should surface
             pass
 
 

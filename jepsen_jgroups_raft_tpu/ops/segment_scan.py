@@ -411,7 +411,7 @@ def check_segmented_batch(encs: Sequence[EncodedHistory], model,
     ax = mesh.axis_names[0]
     sh3 = NamedSharding(mesh, P(ax, None, None))
     sh2 = NamedSharding(mesh, P(ax, None))
-    F = np.asarray(kernel(
+    F = np.asarray(kernel(  # lint: allow(host-sync) — host composition next
         _jax.device_put(events, sh3), _jax.device_put(val_of, sh2),
         _jax.device_put(seed_mask, sh2),
         _jax.device_put(seed_state, sh2)))[:K_tot]

@@ -17,7 +17,6 @@ the CLI flag's documented intent (raft.clj:24-27).
 from __future__ import annotations
 
 import random
-from typing import Optional
 
 from ..checker.base import compose
 from ..checker.independent import IndependentLinearizable

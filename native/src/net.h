@@ -152,9 +152,9 @@ class Transport {
     int port = 0;
     std::mutex qmu;  // guards queue AND fd transitions
     std::condition_variable qcv;
-    std::deque<Bytes> queue;
+    std::deque<Bytes> queue;        // GUARDED_BY(qmu)
     std::atomic<bool> alive{false};
-    int fd = -1;
+    int fd = -1;                    // GUARDED_BY(qmu)
     static constexpr size_t kMaxQueue = 4096;
 
     void run() {
@@ -178,7 +178,7 @@ class Transport {
       qcv.notify_one();
     }
 
-    void close_fd_locked() {
+    void close_fd_locked() {  // REQUIRES(qmu)
       if (fd >= 0) ::close(fd);
       fd = -1;
     }
@@ -294,9 +294,10 @@ class Transport {
   std::thread accept_thread_;
   std::mutex mu_;
   std::condition_variable drained_cv_;
-  std::map<std::string, std::shared_ptr<Link>> links_;
-  std::set<int> inbound_;  // live inbound reader fds (drained by stop)
-  std::set<std::string> blocked_;
+  std::map<std::string, std::shared_ptr<Link>> links_;  // GUARDED_BY(mu_)
+  std::set<int> inbound_;   // GUARDED_BY(mu_) — live inbound reader fds
+                            // (drained by stop)
+  std::set<std::string> blocked_;  // GUARDED_BY(mu_)
 };
 
 }  // namespace raftnative

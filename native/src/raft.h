@@ -373,12 +373,13 @@ class RaftNode {
     }
   }
 
-  bool self_in_config_locked() const {
+  bool self_in_config_locked() const {  // REQUIRES(mu_)
     for (const auto& m : config_)
       if (m.name == opt_.name) return true;
     return false;  // removed members must not disrupt elections
   }
 
+  // REQUIRES(mu_)
   void start_election_locked(std::vector<std::pair<std::string, Bytes>>& out) {
     uint64_t term = log_.current_term() + 1;
     log_.set_term_vote(term, opt_.name);
@@ -443,7 +444,7 @@ class RaftNode {
     maybe_win_locked();
   }
 
-  void maybe_win_locked() {
+  void maybe_win_locked() {  // REQUIRES(mu_)
     size_t have = 0;
     for (const auto& m : config_)
       if (votes_.count(m.name)) ++have;
@@ -463,9 +464,10 @@ class RaftNode {
     next_heartbeat_ = Clock::now();  // heartbeat immediately
   }
 
+  // REQUIRES(mu_)
   size_t majority_locked() const { return config_.size() / 2 + 1; }
 
-  void step_down_locked(uint64_t term) {
+  void step_down_locked(uint64_t term) {  // REQUIRES(mu_)
     bool was_leader = (role_ == Role::Leader);
     role_ = Role::Follower;
     if (term > log_.current_term()) {
@@ -481,7 +483,7 @@ class RaftNode {
     reset_election_deadline();
   }
 
-  void fail_pending_locked(const std::string& why) {
+  void fail_pending_locked(const std::string& why) {  // REQUIRES(mu_)
     // INDEFINITE, not NOT_LEADER: an entry appended by a deposed leader may
     // have reached a majority and can still commit under the new leader.
     // Answering "definite failure" here would let the harness record :fail
@@ -494,7 +496,8 @@ class RaftNode {
     pending_.clear();
   }
 
-  void reset_election_deadline() {
+  // Always called with mu_ held (writes election_deadline_/rng_).
+  void reset_election_deadline() {  // REQUIRES(mu_)
     std::uniform_int_distribution<int> jitter(opt_.election_ms,
                                               2 * opt_.election_ms);
     election_deadline_ = Clock::now() + std::chrono::milliseconds(jitter(rng_));
@@ -514,6 +517,7 @@ class RaftNode {
     for (auto& [peer, frame] : outbox) tr_->send(peer, std::move(frame));
   }
 
+  // REQUIRES(mu_)
   void queue_appends_locked(std::vector<std::pair<std::string, Bytes>>& out) {
     constexpr uint64_t kMaxBatch = 256;
     for (const auto& m : config_) {
@@ -758,6 +762,7 @@ class RaftNode {
   // Shared follower-progress bookkeeping for successful APP and SNAP
   // responses. Returns whether the follower still trails the log (the
   // caller should trigger another append round).
+  // REQUIRES(mu_)
   bool advance_follower_locked(const std::string& follower, uint64_t match) {
     match_index_[follower] = std::max(match_index_[follower], match);
     next_index_[follower] = match_index_[follower] + 1;
@@ -765,7 +770,7 @@ class RaftNode {
     return next_index_[follower] <= log_.last_index();
   }
 
-  void maybe_advance_commit_locked() {
+  void maybe_advance_commit_locked() {  // REQUIRES(mu_)
     if (role_ != Role::Leader) return;
     std::vector<uint64_t> matches;
     for (const auto& m : config_)
@@ -856,12 +861,12 @@ class RaftNode {
   }
 
   // Config takes effect at APPEND time (single-server change discipline).
-  void adopt_config(const Bytes& data) {
+  void adopt_config(const Bytes& data) {  // REQUIRES(mu_)
     config_ = decode_config(data);
     sync_transport_addresses();
   }
 
-  void reconfig_from_log_locked() {
+  void reconfig_from_log_locked() {  // REQUIRES(mu_)
     // Precedence: last E_CONFIG among retained entries > the snapshot's
     // config-at-base > the bootstrap member list.
     config_ = opt_.initial_members;
@@ -879,7 +884,7 @@ class RaftNode {
   // Cluster config as of log position `idx` (for snapshot metadata): the
   // last E_CONFIG at or below idx, else the current snapshot's config,
   // else the bootstrap list.
-  Bytes config_bytes_at_locked(uint64_t idx) const {
+  Bytes config_bytes_at_locked(uint64_t idx) const {  // REQUIRES(mu_)
     for (uint64_t i = idx; i > log_.base_index(); --i)
       if (log_.at(i).type == wire::E_CONFIG) return log_.at(i).data;
     if (log_.has_snapshot() && !log_.snapshot_config().empty())
@@ -887,7 +892,7 @@ class RaftNode {
     return encode_config(opt_.initial_members);
   }
 
-  void sync_transport_addresses() {
+  void sync_transport_addresses() {  // REQUIRES(mu_)
     for (const auto& m : config_)
       tr_->set_address(m.name, m.host, m.peer_port);
   }
@@ -982,28 +987,34 @@ class RaftNode {
   }
 
   // ---- state -----------------------------------------------------------
+  // GUARDED_BY comments are machine-checked: graftlint's lock-discipline
+  // analyzer (jepsen_jgroups_raft_tpu/lint/lock_discipline.py) verifies
+  // every use of an annotated field happens in a function that locks the
+  // named mutex or is annotated // REQUIRES(mu).
 
   Options opt_;
   StateMachine* sm_;
   Transport* tr_;
-  std::mt19937 rng_;
+  std::mt19937 rng_;  // GUARDED_BY(mu_)
 
   std::mutex mu_;
-  Role role_ = Role::Follower;
-  std::string leader_hint_;
-  std::vector<MemberSpec> config_;
-  RaftLog log_;
-  uint64_t commit_index_ = 0;
-  uint64_t last_applied_ = 0;
-  std::map<std::string, uint64_t> next_index_, match_index_;
-  std::set<std::string> votes_;
-  Clock::time_point election_deadline_{};
-  Clock::time_point next_heartbeat_{};
-  std::map<uint64_t, std::shared_ptr<Pending>> pending_;
+  Role role_ = Role::Follower;               // GUARDED_BY(mu_)
+  std::string leader_hint_;                  // GUARDED_BY(mu_)
+  std::vector<MemberSpec> config_;           // GUARDED_BY(mu_)
+  RaftLog log_;                              // GUARDED_BY(mu_)
+  uint64_t commit_index_ = 0;                // GUARDED_BY(mu_)
+  uint64_t last_applied_ = 0;                // GUARDED_BY(mu_)
+  std::map<std::string, uint64_t> next_index_;   // GUARDED_BY(mu_)
+  std::map<std::string, uint64_t> match_index_;  // GUARDED_BY(mu_)
+  std::set<std::string> votes_;              // GUARDED_BY(mu_)
+  Clock::time_point election_deadline_{};    // GUARDED_BY(mu_)
+  Clock::time_point next_heartbeat_{};       // GUARDED_BY(mu_)
+  std::map<uint64_t, std::shared_ptr<Pending>> pending_;  // GUARDED_BY(mu_)
 
   std::mutex fwd_mu_;
-  uint64_t next_fwd_id_ = 1;
-  std::map<uint64_t, std::shared_ptr<std::promise<Result>>> fwd_pending_;
+  uint64_t next_fwd_id_ = 1;  // GUARDED_BY(fwd_mu_)
+  std::map<uint64_t, std::shared_ptr<std::promise<Result>>>
+      fwd_pending_;  // GUARDED_BY(fwd_mu_)
   static constexpr int kMaxFwdInflight = 256;
   std::atomic<int> fwd_inflight_{0};
 

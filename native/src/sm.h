@@ -170,7 +170,7 @@ class MapStateMachine : public StateMachine {
   }
 
  private:
-  Bytes encode_get(uint64_t key) {
+  Bytes encode_get(uint64_t key) {  // REQUIRES(mu_)
     Buf b;
     auto it = map_.find(key);
     b.u8(it != map_.end() ? 1 : 0);
@@ -179,7 +179,7 @@ class MapStateMachine : public StateMachine {
   }
 
   std::mutex mu_;
-  std::map<uint64_t, int64_t> map_;
+  std::map<uint64_t, int64_t> map_;  // GUARDED_BY(mu_)
 };
 
 class CounterStateMachine : public StateMachine {
@@ -318,7 +318,7 @@ class CounterStateMachine : public StateMachine {
 
  private:
   std::mutex mu_;
-  std::map<std::string, int64_t> counters_;
+  std::map<std::string, int64_t> counters_;  // GUARDED_BY(mu_)
 };
 
 class ElectionStateMachine : public StateMachine {

@@ -1,7 +1,6 @@
 """CLI layer: the `test` and `serve` commands (reference raft.clj:94-101)."""
 
 import json
-import os
 
 import pytest
 

@@ -1,6 +1,8 @@
 """Error-taxonomy tests (reference workload/client.clj:52-63 semantics) and
 regression tests for kernel capacity limits."""
 
+import socket
+
 import pytest
 
 from jepsen_jgroups_raft_tpu.client import (
@@ -10,6 +12,7 @@ from jepsen_jgroups_raft_tpu.client import (
     SocketBroken,
     with_errors,
 )
+from jepsen_jgroups_raft_tpu.client.errors import classify_error
 from jepsen_jgroups_raft_tpu.history.ops import FAIL, INFO, INVOKE, NEMESIS, OK, Op
 from jepsen_jgroups_raft_tpu.checker import LinearizableChecker
 from jepsen_jgroups_raft_tpu.models import CasRegister
@@ -59,6 +62,62 @@ class TestTaxonomy:
         def invoke(test, op):
             return op.replace(type=OK)
         assert with_errors(invoke, {}, _op()).type == OK
+
+
+class TestClassifyOrdering:
+    """classify_error's isinstance ladder is order-sensitive: every
+    indefinite type is a subclass of a broader type that also appears in
+    the ladder (``SocketBroken`` ⊂ ``OSError``, ``ClientTimeout`` ⊂
+    ``TimeoutError`` ⊂ ``OSError``, ``ConnectFailed`` ⊂
+    ``ConnectionError`` ⊂ ``OSError``). A reordering that matched a
+    broad parent first could silently flip definiteness — the exact
+    unsoundness the graftlint taxonomy rules guard at the call sites
+    (ISSUE 1 satellite)."""
+
+    def test_socket_broken_is_indefinite_despite_oserror_parent(self):
+        assert issubclass(SocketBroken, OSError)
+        definite, kind, _ = classify_error(SocketBroken("reset"))
+        assert (definite, kind) == (False, "socket")
+
+    def test_client_timeout_matches_before_oserror(self):
+        assert issubclass(ClientTimeout, TimeoutError)
+        assert issubclass(TimeoutError, OSError)
+        definite, kind, _ = classify_error(ClientTimeout("10s"))
+        assert (definite, kind) == (False, "timeout")
+
+    def test_plain_timeout_and_socket_timeout_are_indefinite(self):
+        for exc in (TimeoutError(), socket.timeout()):
+            definite, kind, _ = classify_error(exc)
+            assert (definite, kind) == (False, "timeout")
+
+    def test_connection_refused_is_definite_before_broad_oserror(self):
+        # definite refusal must win over the catch-all OSError→socket
+        # branch below it: the request never reached a server
+        assert issubclass(ConnectionRefusedError, OSError)
+        for exc in (ConnectFailed(), ConnectionRefusedError()):
+            definite, kind, _ = classify_error(exc)
+            assert (definite, kind) == (True, "connect")
+
+    def test_mid_request_connection_death_is_indefinite(self):
+        # ConnectionResetError is a ConnectionError but NOT a refusal:
+        # the request may have been received — must stay indefinite
+        definite, kind, _ = classify_error(ConnectionResetError())
+        assert (definite, kind) == (False, "socket")
+        definite, kind, _ = classify_error(OSError("EPIPE"))
+        assert (definite, kind) == (False, "socket")
+
+    def test_idempotent_downgrade_indefinite_to_fail(self):
+        # an indefinite error on an idempotent op records FAIL safely:
+        # re-executing or not executing a read is model-invisible
+        for exc in (SocketBroken(), ClientTimeout(), TimeoutError()):
+            out = with_errors(_raising(exc), {}, _op("read", None),
+                              idempotent={"read"})
+            assert out.type == FAIL
+        # the same errors on a non-idempotent op must record INFO
+        for exc in (SocketBroken(), ClientTimeout()):
+            out = with_errors(_raising(exc), {}, _op("write", 3),
+                              idempotent={"read"})
+            assert out.type == INFO
 
 
 class TestKernelCapacity:

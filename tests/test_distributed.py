@@ -9,7 +9,6 @@ gRPC, shard one 16-history batch, and each must observe the globally
 psum-aggregated verdict count.
 """
 
-import os
 import subprocess
 import sys
 from pathlib import Path

@@ -7,7 +7,6 @@ This exercises every layer boundary:
 generator → client → history → pack → kernel → checker-compose → store.
 """
 
-import glob
 import json
 import os
 

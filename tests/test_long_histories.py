@@ -6,7 +6,6 @@ SURVEY.md §5.7)."""
 
 import random
 
-import pytest
 
 from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
 from jepsen_jgroups_raft_tpu.history.ops import OK

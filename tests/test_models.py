@@ -3,7 +3,6 @@ and encoding must implement the reference's completion-type semantics."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from jepsen_jgroups_raft_tpu.history.ops import Op, OpPair, INVOKE, OK, FAIL, INFO
 from jepsen_jgroups_raft_tpu.models import CasRegister, Counter, NIL
