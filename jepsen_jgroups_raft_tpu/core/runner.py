@@ -41,6 +41,24 @@ LOG = logging.getLogger("jgraft.core")
 POLL_INTERVAL = 0.002
 
 
+def _open_client(proto, test: dict, node: str):
+    """open + setup as ONE acquisition: when setup raises, the half-open
+    connection is closed before the error propagates. Before this
+    (graftcheck flow-resource-leak finding), every worker whose setup
+    failed dropped an open socket on the floor — invisible per run,
+    fd exhaustion across a long hell campaign."""
+    client = proto.open(test, node)
+    try:
+        client.setup(test)
+    except BaseException:
+        try:
+            client.close(test)
+        except Exception:
+            LOG.debug("close of half-open client failed", exc_info=True)
+        raise
+    return client
+
+
 class Scheduler:
     """Serializes generator access across workers; owns test time."""
 
@@ -128,8 +146,7 @@ def run_test(test: dict) -> dict:
             # loop below retries per-op and records :fail until it heals
             # (otherwise the generator never drains and run_test hangs).
             try:
-                client = proto.open(test, node)
-                client.setup(test)
+                client = _open_client(proto, test, node)
             except Exception:
                 LOG.exception("worker %d: initial open failed; will retry", i)
                 client = None
@@ -143,11 +160,14 @@ def run_test(test: dict) -> dict:
                 record(inv)
                 if proto is not None and client is None:
                     # Previous reconnect failed; retry before invoking.
+                    # (_open_client: a failed setup must leave client
+                    # None AND closed, not a half-open object the next
+                    # invoke would use.)
                     try:
-                        client = proto.open(test, node)
-                        client.setup(test)
+                        client = _open_client(proto, test, node)
                     except Exception:
                         LOG.exception("worker %d: reconnect failed", i)
+                        client = None
                 if proto is None:
                     comp = inv.replace(type="ok")
                 elif client is None:
@@ -179,19 +199,27 @@ def run_test(test: dict) -> dict:
                             LOG.debug("worker %d: close after info op "
                                       "failed", i, exc_info=True)
                         try:
-                            client = proto.open(test, node)
-                            client.setup(test)
+                            client = _open_client(proto, test, node)
                         except Exception:
                             LOG.exception(
                                 "worker %d: reopen failed; will retry", i)
                             client = None
         finally:
             if client is not None:
+                # teardown and close are SEPARATE obligations: a raising
+                # teardown used to skip close entirely (graftcheck
+                # flow-resource-leak finding), leaking the socket of
+                # every worker whose workload teardown failed.
                 try:
                     client.teardown(test)
-                    client.close(test)
                 except Exception:
                     LOG.exception("client teardown failed (node %s)", node)
+                finally:
+                    try:
+                        client.close(test)
+                    except Exception:
+                        LOG.debug("client close failed (node %s)", node,
+                                  exc_info=True)
 
     def nemesis_worker() -> None:
         # Always run the nemesis loop: with no nemesis configured, a noop

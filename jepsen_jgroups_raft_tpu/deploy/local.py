@@ -47,8 +47,11 @@ def _free_ports(n: int) -> list:
     try:
         for _ in range(n):
             s = socket.socket()
-            s.bind(("127.0.0.1", 0))
+            # adopt into the cleanup list BEFORE bind: a bind that
+            # raises used to leak the just-created socket (created but
+            # not yet listed — graftcheck flow-resource-leak finding).
             socks.append(s)
+            s.bind(("127.0.0.1", 0))
         return [s.getsockname()[1] for s in socks]
     finally:
         for s in socks:
@@ -145,17 +148,20 @@ class LocalCluster:
             return "already-running"
         names = sorted(set(members) | {name})
         members_arg = ",".join(self.spec(n) for n in names)
-        log = open(self.log_path(name), "ab")
-        self.procs[name] = subprocess.Popen(
-            [self.server_bin, "--name", name, "--members", members_arg,
-             "--sm", self.sm, "--log-dir", str(self.workdir / "raftlog"),
-             "--election-ms", str(self.election_ms),
-             "--heartbeat-ms", str(self.heartbeat_ms),
-             "--repl-timeout-ms", str(self.repl_timeout_ms)]
-            + (["--compact-every", str(self.compact_every)]
-               if self.compact_every else []),
-            stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
-        log.close()
+        # `with`: a Popen that raises (missing/denied binary) used to
+        # leak the log handle (graftcheck flow-resource-leak finding);
+        # the spawned child keeps its own dup of the fd.
+        with open(self.log_path(name), "ab") as log:
+            self.procs[name] = subprocess.Popen(
+                [self.server_bin, "--name", name, "--members", members_arg,
+                 "--sm", self.sm, "--log-dir", str(self.workdir / "raftlog"),
+                 "--election-ms", str(self.election_ms),
+                 "--heartbeat-ms", str(self.heartbeat_ms),
+                 "--repl-timeout-ms", str(self.repl_timeout_ms)]
+                + (["--compact-every", str(self.compact_every)]
+                   if self.compact_every else []),
+                stdout=log, stderr=subprocess.STDOUT,
+                start_new_session=True)
         if wait:
             wait_for_port(*((self.resolve(name))))
         return "started"

@@ -1,6 +1,8 @@
 """graftlint: project-native static analysis (see ISSUE/doc).
 
-Three analyzers, one per repo-level invariant no generic linter knows:
+Six analyzers, one per repo-level invariant no generic linter knows —
+three pattern-level (PR 1), three CFG/dataflow (the graftcheck tier,
+:mod:`.flow`):
 
 * :mod:`.taxonomy` — exception paths that record op outcomes must
   respect the definite/indefinite taxonomy (client/errors.py), or the
@@ -11,10 +13,19 @@ Three analyzers, one per repo-level invariant no generic linter knows:
 * :mod:`.lock_discipline` — ``// GUARDED_BY(mu)`` fields in
   ``native/src`` are only touched under their mutex (or in
   ``// REQUIRES(mu)`` helpers).
+* :mod:`.flow.kernel_contract` — Pallas BlockSpec/grid/out_shape
+  arithmetic verified statically under sampled contract bindings,
+  with Mosaic tiling rules and a VMEM budget.
+* :mod:`.flow.heal` — every nemesis fault-injection path heals,
+  registers for teardown, or carries ``# lint: allow(unhealed)``.
+* :mod:`.flow.resource` — acquire/release balance across exception
+  paths in the deploy/runner tiers.
 
-CLI: ``python -m jepsen_jgroups_raft_tpu.lint [paths]`` —
+CLI: ``python -m jepsen_jgroups_raft_tpu.lint [paths]`` — with
+``--format json`` (SARIF 2.1.0) and a regression baseline
+(``--baseline`` / ``--update-baseline``, doc/running.md).
 ``scripts/lint.sh`` is the one-command gate (ruff → graftlint →
-``make -C native tidy``).
+graftcheck → ``make -C native tidy``).
 """
 
 from .base import Finding, SourceFile  # noqa: F401

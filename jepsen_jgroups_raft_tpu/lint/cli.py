@@ -3,7 +3,9 @@
 Usage::
 
     python -m jepsen_jgroups_raft_tpu.lint [paths...]
-        [--rules taxonomy,jit,lock] [--list-rules]
+        [--rules taxonomy,jit,lock,kernel,heal,resource] [--list-rules]
+        [--format text|json] [--baseline FILE] [--update-baseline]
+        [--vmem-budget BYTES]
 
 With no paths, lints the repo the package lives in (the self-hosting
 default `scripts/lint.sh` runs). Each analyzer applies only to its scan
@@ -11,24 +13,38 @@ set when given a directory; an explicit single *file* argument is always
 analyzed by every requested analyzer that understands its language —
 that is what the seeded-violation tests (and quick one-file checks) use.
 
-Exit status: 0 clean, 1 findings, 2 usage error.
+Two analyzer tiers: the pattern analyzers from PR 1 (taxonomy, jit,
+lock) and the CFG/dataflow tier (kernel, heal, resource — see
+``lint/flow/``). ``--format json`` emits a SARIF 2.1.0 log. A baseline
+file (default ``lint/baseline.json`` when present) suppresses accepted
+pre-existing findings so the gate fails only on regression;
+``--update-baseline`` rewrites it from the current run.
+
+Exit status: 0 clean (new findings only count), 1 new findings, 2 usage
+error.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
-from typing import List
+from typing import List, Optional
 
-from . import jit_hygiene, lock_discipline, taxonomy
+from . import jit_hygiene, lock_discipline, report, taxonomy
 from .base import Finding, collect_files, rel
+from .flow import heal, kernel_contract, resource
+from .flow.kernel_contract import DEFAULT_VMEM_BUDGET
 
 #: name → (module, suffixes)
 ANALYZERS = {
     "taxonomy": (taxonomy, (".py",)),
     "jit": (jit_hygiene, (".py",)),
     "lock": (lock_discipline, (".h", ".cc")),
+    "kernel": (kernel_contract, (".py",)),
+    "heal": (heal, (".py",)),
+    "resource": (resource, (".py",)),
 }
 
 RULES = {
@@ -37,14 +53,26 @@ RULES = {
     "jit": ("jit-host-sync", "jit-python-branch", "jit-recompile-hazard",
             "host-sync"),
     "lock": ("lock-guarded-field", "lock-unknown-mutex"),
+    "kernel": ("kernel-block-divide", "kernel-grid-cover",
+               "kernel-block-tile", "kernel-dtype", "kernel-vmem-budget",
+               "kernel-unresolved"),
+    "heal": ("flow-unhealed-fault",),
+    "resource": ("flow-resource-leak",),
 }
+
+DEFAULT_RULES = "taxonomy,jit,lock,kernel,heal,resource"
 
 
 def repo_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
-def run(paths: List[str], rules: List[str]) -> List[Finding]:
+def default_baseline() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def run(paths: List[str], rules: List[str],
+        vmem_budget: int = DEFAULT_VMEM_BUDGET) -> List[Finding]:
     root = repo_root()
     explicit = {Path(p).resolve() for p in paths if Path(p).is_file()}
     findings: List[Finding] = []
@@ -55,7 +83,11 @@ def run(paths: List[str], rules: List[str]) -> List[Finding]:
             if not (Path(f).resolve() in explicit or
                     mod.applies_to(relpath)):
                 continue
-            for finding in mod.analyze_file(f):
+            if name == "kernel":
+                found = mod.analyze_file(f, vmem_budget)
+            else:
+                found = mod.analyze_file(f)
+            for finding in found:
                 findings.append(Finding(relpath, finding.line,
                                         finding.rule, finding.message))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
@@ -64,13 +96,26 @@ def run(paths: List[str], rules: List[str]) -> List[Finding]:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m jepsen_jgroups_raft_tpu.lint",
-        description="graftlint: checker-soundness, jit-hygiene and "
-                    "native lock-discipline analysis")
+        description="graftlint: checker-soundness, jit-hygiene, native "
+                    "lock-discipline and CFG/dataflow (kernel-contract, "
+                    "fault-heal, resource-leak) analysis")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the repo)")
-    parser.add_argument("--rules", default="taxonomy,jit,lock",
+    parser.add_argument("--rules", default=DEFAULT_RULES,
                         help="comma-separated analyzer subset")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="text (default) or SARIF 2.1.0 JSON")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="baseline file of accepted findings "
+                             "(default: lint/baseline.json when present)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from this run's "
+                             "findings and exit 0")
+    parser.add_argument("--vmem-budget", type=int,
+                        default=DEFAULT_VMEM_BUDGET, metavar="BYTES",
+                        help="kernel-contract per-program VMEM budget")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -94,13 +139,47 @@ def main(argv=None) -> int:
 
     paths = args.paths or [str(repo_root() / "jepsen_jgroups_raft_tpu"),
                            str(repo_root() / "native" / "src")]
-    findings = run(paths, rules)
-    for f in findings:
-        print(f.render())
-    if findings:
-        print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+    findings = run(paths, rules, vmem_budget=args.vmem_budget)
+
+    fps = report.fingerprints(findings, repo_root())
+    baseline_path: Optional[Path] = (
+        Path(args.baseline) if args.baseline else default_baseline())
+    if args.update_baseline:
+        new_fps = {fp for _, fp in fps}
+        # A partial run (analyzer subset or explicit paths) only SAW part
+        # of the repo: rewriting from it would silently drop every
+        # accepted fingerprint outside the run's scope, so merge instead.
+        # Only the full default run is authoritative enough to prune.
+        partial = bool(args.paths) or set(rules) != set(ANALYZERS)
+        if partial:
+            new_fps |= report.load_baseline(baseline_path)
+        report.save_baseline(baseline_path, sorted(new_fps))
+        print(f"baseline: wrote {len(new_fps)} finding(s) to "
+              f"{baseline_path}"
+              + (" (partial run: merged with existing)" if partial else ""),
+              file=sys.stderr)
+        return 0
+    baseline = report.load_baseline(baseline_path)
+    suppressed = [fp in baseline for _, fp in fps]
+    new = [f for f, sup in zip(findings, suppressed) if not sup]
+
+    if args.format == "json":
+        rule_ids = [r for a in rules for r in RULES[a]]
+        print(json.dumps(report.to_sarif(findings, suppressed, rule_ids),
+                         indent=2))
+    else:
+        for f in new:
+            print(f.render())
+
+    n_base = sum(suppressed)
+    if new:
+        print(f"graftlint: {len(new)} new finding(s)"
+              + (f" ({n_base} baselined)" if n_base else ""),
+              file=sys.stderr)
         return 1
-    print(f"graftlint: clean ({', '.join(rules)})")
+    tail = f" — {n_base} baselined finding(s)" if n_base else ""
+    print(f"graftlint: clean ({', '.join(rules)}){tail}",
+          file=sys.stderr if args.format == "json" else sys.stdout)
     return 0
 
 

@@ -1,0 +1,475 @@
+"""Kernel-contract analyzer: static Pallas/launch shape verification.
+
+A mis-sized ``BlockSpec``, a grid that does not tile the output, or a
+VMEM-oversized block is today a *runtime* failure — Mosaic rejects the
+lowering or XLA OOMs — discovered only after burning (tunneled, paid)
+TPU time. This analyzer evaluates the shape arithmetic around every
+``pl.pallas_call`` **statically**: the enclosing scopes' assignments are
+executed by the restricted interpreter (:mod:`.interp`) under sampled
+symbol bindings drawn from the file's declared contract, and the
+resulting concrete grids/blocks/shapes are checked against the Mosaic
+and VMEM rules. Files with no symbols (test fixtures with literal
+shapes) evaluate under the single empty binding.
+
+Rules
+-----
+``kernel-block-divide``
+    An out_spec block dim does not divide the declared ``out_shape`` dim.
+``kernel-grid-cover``
+    grid × block (via the evaluated ``index_map``) covers a different
+    extent than the declared ``out_shape`` — the grid either misses part
+    of the output or writes out of bounds.
+``kernel-block-tile``
+    Mosaic tiling: a block's lane dim must be a multiple of 128 and its
+    sublane dim a multiple of 8, unless it spans the full (implied)
+    array dim.
+``kernel-dtype``
+    A 64-bit ``out_shape`` dtype — does not propagate on TPU without
+    x64 mode; the kernel would silently compute in 32 bits or fail.
+``kernel-vmem-budget``
+    Per-program resident block bytes (Σ in/out blocks) exceed the VMEM
+    budget (default ~12 MiB of the ~16 MiB/core, CLI-configurable), or
+    a contract's named budget invariant fails (e.g. ``tile_histories``
+    must keep the lane-expanded event block inside
+    ``_EVENTS_VMEM_BUDGET`` for every legal (S, E)).
+``kernel-unresolved``
+    The analyzer could not evaluate a shape it needed — a loud finding,
+    never a silent pass, so adding symbols to a kernel without extending
+    its contract fails the gate instead of going unchecked.
+
+Scan set (CLI): ``ops/pallas_scan.py``, ``ops/segment_scan.py``,
+``ops/dense_scan.py``, ``parallel/mesh.py`` — the non-Pallas files are
+covered for their declared cap/budget constants and for any
+``pallas_call`` a future PR adds there.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from itertools import product
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..base import Finding, SourceFile, filter_allowed
+from .interp import UNKNOWN, Closure, Dotted, Interp, _Abort, _Return
+
+DEFAULT_VMEM_BUDGET = 12 << 20
+
+#: dtypes that do not exist on TPU without jax x64 mode.
+_BAD_DTYPES = {"float64", "int64", "uint64", "complex128"}
+
+_DTYPE_BYTES = {"int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+                "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+                "int32": 4, "uint32": 4, "float32": 4}
+
+
+@dataclass
+class Contract:
+    """Per-file symbol domains + named budget invariants."""
+
+    #: symbol name -> candidate values (parameters of the functions
+    #: enclosing the pallas_call); cross product, filtered by `where`.
+    symbols: Dict[str, Tuple] = field(default_factory=dict)
+    where: Optional[callable] = None
+    #: (expr over module constants, max value, message) rows checked once.
+    const_asserts: List[Tuple[str, int, str]] = field(default_factory=list)
+    #: optional callable(interp) -> List[str] for file-specific budget
+    #: invariants that need to *run* module functions.
+    custom: Optional[callable] = None
+
+
+def _pallas_scan_tile_budget(interp: Interp) -> List[str]:
+    """tile_histories(S, E) must keep the lane-expanded event block
+    ([5·E, T·S] int32 = T·S·E·20 bytes) inside _EVENTS_VMEM_BUDGET for
+    every legal (S, E) — the exact invariant its docstring claims."""
+    out = []
+    budget = interp.module_env.get("_EVENTS_VMEM_BUDGET")
+    fn = interp.functions.get("tile_histories")
+    if not isinstance(budget, int) or fn is None:
+        return ["tile_histories/_EVENTS_VMEM_BUDGET not resolvable"]
+    for S in (1, 2, 4, 8, 16):
+        for E in (8, 64, 512, 4096, 131072):
+            T = interp.exec_fn(fn, {"n_states": S, "n_events": E})
+            if not isinstance(T, int):
+                out.append(f"tile_histories({S}, {E}) not evaluable")
+                continue
+            if T * S * E * 20 > budget and T > 1:
+                out.append(
+                    f"tile_histories({S}, {E}) = {T}: event block "
+                    f"{T * S * E * 20} B exceeds _EVENTS_VMEM_BUDGET "
+                    f"{budget} B")
+    return out
+
+
+CONTRACTS: Dict[str, Contract] = {
+    "ops/pallas_scan.py": Contract(
+        symbols={"W": (5,), "S": (1, 4, 16), "E": (8, 64, 512),
+                 "T": (1, 4, 32), "G": (1, 2, 8), "interpret": (False,)},
+        # the legal envelope tile_histories/make_pallas_batch_checker
+        # guarantee: lane axis filled but never overfilled, E padded to
+        # a multiple of 8 (Mosaic sublane rule).
+        where=lambda b: b["T"] * b["S"] <= 128 and b["E"] % 8 == 0,
+        const_asserts=[
+            ("_EVENTS_VMEM_BUDGET", 16 << 20,
+             "events VMEM budget exceeds usable per-core VMEM"),
+            ("_LANE_TARGET", 128, "lane target beyond the 128-lane VPU"),
+        ],
+        custom=_pallas_scan_tile_budget,
+    ),
+    "ops/dense_scan.py": Contract(const_asserts=[
+        ("(1 << DENSE_MAX_SLOTS) * DENSE_MAX_STATES * 4", 16 << 20,
+         "dense frontier at the eligibility caps exceeds VMEM"),
+        ("DENSE_MAX_CELLS * 4", 16 << 20,
+         "dense cell cap exceeds VMEM"),
+        ("(1 << MASK_DENSE_MAX_SLOTS) * 8", 16 << 20,
+         "mask frontier + subset-sum lane at the cap exceeds VMEM"),
+    ]),
+    "ops/segment_scan.py": Contract(const_asserts=[
+        ("MAX_BASIS * DENSE_MAX_CELLS * 4", 16 << 20,
+         "segment seed-basis frontier at the caps exceeds VMEM"),
+        ("DEFAULT_BLOCK_EVENTS * 5 * 4", 16 << 20,
+         "segment event slab exceeds VMEM"),
+    ]),
+    "parallel/mesh.py": Contract(),
+}
+
+SCAN_FILES = tuple(CONTRACTS)
+
+
+def applies_to(relpath: str) -> bool:
+    rp = relpath.replace("\\", "/")
+    rp = rp.split("jepsen_jgroups_raft_tpu/", 1)[-1]
+    return rp in SCAN_FILES
+
+
+def _contract_for(path: str) -> Contract:
+    rp = str(path).replace("\\", "/")
+    for key, c in CONTRACTS.items():
+        if rp.endswith(key):
+            return c
+    return Contract()
+
+
+# ------------------------------------------------------------ extraction
+
+
+def _leaf(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _enclosing_chain(tree: ast.Module) -> List[Tuple[ast.Call, list]]:
+    """[(pallas_call node, [enclosing FunctionDefs outer→inner])]."""
+    out = []
+
+    def walk(node, chain):
+        for child in ast.iter_child_nodes(node):
+            nc = chain
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nc = chain + [child]
+            if isinstance(child, ast.Call) and \
+                    _leaf(child) == "pallas_call":
+                out.append((child, list(nc)))
+            walk(child, nc)
+
+    walk(tree, [])
+    return out
+
+
+def _merge_sibling_consts(interp: Interp, tree: ast.Module,
+                          path: str) -> None:
+    """Resolve `from .sibling import NAME` constants so cross-module cap
+    expressions (segment_scan uses dense_scan's caps) stay checkable."""
+    base = Path(path).parent
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.ImportFrom) and stmt.level >= 1
+                and stmt.module):
+            continue
+        sib = base / (stmt.module.split(".")[-1] + ".py")
+        if not sib.exists():
+            continue
+        try:
+            sub = Interp(ast.parse(sib.read_text(encoding="utf-8",
+                                                 errors="replace")))
+        except SyntaxError:
+            continue
+        for alias in stmt.names:
+            val = sub.module_env.get(alias.name, UNKNOWN)
+            if val is not UNKNOWN:
+                interp.module_env.setdefault(alias.asname or alias.name,
+                                             val)
+
+
+# -------------------------------------------------------------- checking
+
+
+def _bindings(contract: Contract):
+    if not contract.symbols:
+        return [{}]
+    names = sorted(contract.symbols)
+    out = []
+    for combo in product(*(contract.symbols[n] for n in names)):
+        b = dict(zip(names, combo))
+        if contract.where is None or contract.where(b):
+            out.append(b)
+    return out
+
+
+def _eval_specs(interp: Interp, expr: Optional[ast.expr], env: dict):
+    """BlockSpec list/single ast -> [(shape tuple, index_map Closure)]
+    or None when unresolvable."""
+    if expr is None:
+        return []
+    elts = expr.elts if isinstance(expr, (ast.List, ast.Tuple)) else [expr]
+    specs = []
+    for e in elts:
+        if not (isinstance(e, ast.Call) and _leaf(e) == "BlockSpec"):
+            return None
+        shape_ast = e.args[0] if e.args else _kw(e, "block_shape")
+        imap_ast = e.args[1] if len(e.args) > 1 else _kw(e, "index_map")
+        shape = interp.eval(shape_ast, env) if shape_ast is not None \
+            else None
+        if not (isinstance(shape, tuple) and
+                all(isinstance(d, int) and d > 0 for d in shape)):
+            return None
+        imap = interp.eval(imap_ast, env) if imap_ast is not None else None
+        specs.append((shape, imap if isinstance(imap, Closure) else None))
+    return specs
+
+
+def _eval_out_shapes(interp: Interp, expr: Optional[ast.expr], env: dict):
+    """out_shape ast -> [(shape tuple, dtype leaf str)] or None."""
+    if expr is None:
+        return None
+    elts = expr.elts if isinstance(expr, (ast.List, ast.Tuple)) else [expr]
+    out = []
+    for e in elts:
+        if not (isinstance(e, ast.Call) and
+                _leaf(e) == "ShapeDtypeStruct" and len(e.args) >= 2):
+            return None
+        shape = interp.eval(e.args[0], env)
+        dtype = interp.eval(e.args[1], env)
+        if not (isinstance(shape, tuple) and
+                all(isinstance(d, int) and d > 0 for d in shape)):
+            return None
+        out.append((shape, dtype.leaf if isinstance(dtype, Dotted)
+                    else str(dtype)))
+    return out
+
+
+def _grid_points(grid: Tuple[int, ...]):
+    total = 1
+    for g in grid:
+        total *= g
+    if total <= 4096:
+        return product(*(range(g) for g in grid))
+    # corner sampling for huge grids: extremes bound the index maps the
+    # repo writes (affine in program ids)
+    return product(*(sorted({0, g - 1}) for g in grid))
+
+
+def _implied_extent(shape, imap, grid):
+    """(max block origin + 1) * block per dim, from evaluating the
+    index map over the grid; None when the map is unresolvable."""
+    if imap is None:
+        return None
+    maxo = [0] * len(shape)
+    for point in _grid_points(grid):
+        origins = imap.call(list(point))
+        if not (isinstance(origins, tuple) and len(origins) == len(shape)
+                and all(isinstance(o, int) and o >= 0 for o in origins)):
+            return None
+        for d, o in enumerate(origins):
+            maxo[d] = max(maxo[d], o)
+    return tuple((m + 1) * s for m, s in zip(maxo, shape))
+
+
+def _tile_violations(shape, implied) -> List[str]:
+    if len(shape) < 2:
+        return []
+    if implied is None:
+        # no (resolvable) index_map: pallas defaults to a whole-array
+        # block, which spans the full dims by definition — there is no
+        # tile violation to assert, and claiming one would flag every
+        # default BlockSpec.
+        return []
+    out = []
+    lane, sub = shape[-1], shape[-2]
+    full_lane = implied[-1]
+    full_sub = implied[-2]
+    if lane % 128 and lane != full_lane:
+        out.append(f"lane dim {lane} is neither a multiple of 128 nor "
+                   f"the full array dim ({full_lane})")
+    if sub % 8 and sub != full_sub:
+        out.append(f"sublane dim {sub} is neither a multiple of 8 nor "
+                   f"the full array dim ({full_sub})")
+    return out
+
+
+def _check_call(call: ast.Call, chain: list, contract: Contract,
+                interp: Interp, budget: int) -> List[Tuple[str, str]]:
+    """One pallas_call over every contract binding -> [(rule, message)],
+    deduped (first offending binding reported)."""
+    seen = {}
+    for binding in _bindings(contract):
+        env = dict(binding)
+        aborted = False
+        for fn in chain:
+            args = fn.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                env.setdefault(a.arg, UNKNOWN)
+            try:
+                interp.lenient = True
+                interp.exec_body(fn.body, env)
+            except _Return:
+                pass
+            except _Abort:
+                # e.g. a loop past the interpreter's iteration ceiling:
+                # the harvested env is partial and untrustworthy, so the
+                # sample is reported unresolved below — a loud finding,
+                # never a crashed lint run or a shape check against
+                # half-evaluated values.
+                aborted = True
+            finally:
+                interp.lenient = False
+
+        def unresolved(what):
+            seen.setdefault(("kernel-unresolved", what),
+                            f"cannot statically evaluate {what} — extend "
+                            "the file's contract in lint/flow/"
+                            "kernel_contract.py or simplify the "
+                            "expression")
+
+        if aborted:
+            unresolved("the enclosing scope (interpreter abort)")
+            continue
+
+        grid_ast = _kw(call, "grid")
+        grid = interp.eval(grid_ast, env) if grid_ast is not None else ()
+        if isinstance(grid, int):
+            grid = (grid,)
+        if not (isinstance(grid, tuple) and
+                all(isinstance(g, int) and g > 0 for g in grid)):
+            unresolved("grid")
+            continue
+        in_specs = _eval_specs(interp, _kw(call, "in_specs"), env)
+        out_specs = _eval_specs(interp, _kw(call, "out_specs"), env)
+        out_shapes = _eval_out_shapes(interp, _kw(call, "out_shape"), env)
+        if in_specs is None:
+            unresolved("in_specs")
+            continue
+        if out_specs is None or out_shapes is None:
+            unresolved("out_specs/out_shape")
+            continue
+
+        blocks_bytes = 0
+        for shape, imap in in_specs:
+            implied = _implied_extent(shape, imap, grid)
+            for v in _tile_violations(shape, implied):
+                seen.setdefault(("kernel-block-tile", v),
+                                f"in_spec block {shape} at {binding}: {v}")
+            blocks_bytes += _prod(shape) * 4  # int32-dominated inputs
+
+        for i, (shape, imap) in enumerate(out_specs):
+            decl, dtype = out_shapes[i] if i < len(out_shapes) else \
+                (None, "int32")
+            if dtype in _BAD_DTYPES:
+                seen.setdefault(("kernel-dtype", dtype),
+                                f"out_shape dtype {dtype}: 64-bit dtypes "
+                                "do not propagate on TPU (x64 off)")
+            nbytes = _DTYPE_BYTES.get(dtype, 4)
+            blocks_bytes += _prod(shape) * nbytes
+            if decl is not None:
+                if len(decl) != len(shape):
+                    seen.setdefault(
+                        ("kernel-block-divide", f"rank{i}"),
+                        f"out_spec block {shape} rank differs from "
+                        f"out_shape {decl}")
+                    continue
+                for d, (b, a) in enumerate(zip(shape, decl)):
+                    if a % b:
+                        seen.setdefault(
+                            ("kernel-block-divide", f"{i}.{d}"),
+                            f"out_spec block dim {b} does not divide "
+                            f"out_shape dim {a} (axis {d}, at {binding})")
+                implied = _implied_extent(shape, imap, grid)
+                if implied is not None and implied != decl:
+                    seen.setdefault(
+                        ("kernel-grid-cover", str(i)),
+                        f"grid {grid} × block {shape} covers {implied} "
+                        f"but out_shape declares {decl} (at {binding})")
+                for v in _tile_violations(shape, decl):
+                    seen.setdefault(("kernel-block-tile", f"out:{v}"),
+                                    f"out_spec block {shape}: {v}")
+
+        if blocks_bytes > budget:
+            seen.setdefault(
+                ("kernel-vmem-budget", "blocks"),
+                f"resident blocks ≈ {blocks_bytes} B exceed the VMEM "
+                f"budget {budget} B (at {binding}; --vmem-budget to "
+                "raise)")
+    return [(rule, msg) for (rule, _detail), msg in seen.items()]
+
+
+def _prod(shape) -> int:
+    out = 1
+    for d in shape:
+        out *= d
+    return out
+
+
+# ------------------------------------------------------------- interface
+
+
+def analyze_source(src: SourceFile,
+                   vmem_budget: int = DEFAULT_VMEM_BUDGET) -> List[Finding]:
+    try:
+        tree = ast.parse(src.text)
+    except SyntaxError as e:
+        return [Finding(src.path, e.lineno or 1, "parse-error", str(e))]
+    contract = _contract_for(src.path)
+    interp = Interp(tree)
+    _merge_sibling_consts(interp, tree, src.path)
+    findings: List[Finding] = []
+
+    for expr, limit, msg in contract.const_asserts:
+        try:
+            val = interp.eval(ast.parse(expr, mode="eval").body, {})
+        except SyntaxError:
+            val = UNKNOWN
+        if not isinstance(val, int):
+            findings.append(Finding(
+                src.path, 1, "kernel-unresolved",
+                f"budget expression {expr!r} not evaluable from module "
+                "constants"))
+        elif val > limit:
+            findings.append(Finding(
+                src.path, 1, "kernel-vmem-budget",
+                f"{expr} = {val} > {limit}: {msg}"))
+
+    if contract.custom is not None:
+        for msg in contract.custom(interp):
+            findings.append(Finding(src.path, 1, "kernel-vmem-budget", msg))
+
+    for call, chain in _enclosing_chain(tree):
+        for rule, msg in _check_call(call, chain, contract, interp,
+                                     vmem_budget):
+            findings.append(Finding(src.path, call.lineno, rule, msg))
+    return filter_allowed(src, findings)
+
+
+def analyze_file(path, vmem_budget: int = DEFAULT_VMEM_BUDGET
+                 ) -> List[Finding]:
+    return analyze_source(SourceFile.load(path), vmem_budget)

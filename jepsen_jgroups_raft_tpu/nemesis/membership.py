@@ -43,6 +43,12 @@ class MemberNemesis(Nemesis):
         # one worker: membership ops are serial anyway, and an abandoned
         # (timed-out) op must finish before the next one starts
         self._pool = ThreadPoolExecutor(1)
+        #: nodes killed for a shrink whose remove AND rollback-start both
+        #: failed: still listed in the members set (so GrowUntilFull sees
+        #: a full membership and never re-grows them) but dead — teardown
+        #: retries the restart so a run cannot end with a permanently
+        #: dead voting member.
+        self.unhealed: set = set()
 
     def invoke(self, test, op: Op) -> Op:
         if op.f == GROW:
@@ -87,10 +93,16 @@ class MemberNemesis(Nemesis):
             # membership.clj:37-40: refuse; the remnant could lose quorum.
             return "will not shrink below majority"
         node = self.rng.choice(sorted(members))
-        # Kill BEFORE removing (membership.clj:87-92).
-        self.db.kill(test, node)
+        # Kill BEFORE removing (membership.clj:87-92). Deliberately NOT
+        # restarted on the success path: the node is leaving the cluster
+        # dead, and the final generator (GrowUntilFull → grow → db.start)
+        # is the healing side of the shrink/grow flip-flop.
+        self.db.kill(test, node)  # lint: allow(unhealed)
         try:
-            self.db.remove_member(test, node)
+            # Removal is healed by regrowth, not by an inline add_member:
+            # GrowUntilFull re-adds removed nodes until the membership is
+            # full again (the reference's final generator).
+            self.db.remove_member(test, node)  # lint: allow(unhealed)
         except Exception:
             # Roll back the kill: without this, a failed remove leaves a
             # permanently-dead voting member that no healing path restarts
@@ -98,13 +110,29 @@ class MemberNemesis(Nemesis):
             try:
                 self.db.start(test, node)
             except Exception:
-                pass  # node stays listed; teardown/final-gen retries
+                # Rollback failed too. Register the orphan so teardown
+                # retries the restart — before this (graftcheck
+                # flow-unhealed-fault finding) the node stayed a dead
+                # voting member forever: still in `members`, so the
+                # final generator never regrew it.
+                self.unhealed.add(node)
             raise
         members.discard(node)
         return {"removed": node, "members": sorted(members)}
 
     def teardown(self, test):
-        self._pool.shutdown(wait=False)
+        # wait=True: an abandoned (timed-out) op may still be running and
+        # can register into self.unhealed at its end — retrying before it
+        # finishes would miss that node (the op's own db calls are
+        # timeout-bounded, so this terminates; same assumption as the
+        # one-worker serialization note in __init__).
+        self._pool.shutdown(wait=True)
+        for node in sorted(self.unhealed):
+            try:
+                self.db.start(test, node)
+                self.unhealed.discard(node)
+            except Exception:
+                pass  # node unreachable; nothing left to drive it with
 
 
 class GrowUntilFull(Generator):

@@ -1,14 +1,20 @@
 #!/usr/bin/env bash
-# The one-command static-analysis gate (ISSUE 1 tentpole):
-#   1. ruff     — generic Python hygiene (pyproject.toml config); skipped
-#                 with a message when not installed (the container doesn't
-#                 ship it; CI images may).
-#   2. graftlint — the project-native analyzers: taxonomy soundness,
-#                 jit/trace hygiene, native lock discipline.
-#   3. make tidy — curated clang-tidy over native/src (self-skipping when
-#                 clang-tidy is absent, same pattern as SKIP_TSAN=1).
-# Exit nonzero on any finding. tests/test_lint.py keeps step 2 green by
-# construction (self-hosting: the suite lints the repo that contains it).
+# The one-command static-analysis gate (ISSUE 1 tentpole + ISSUE 2 flow tier):
+#   1. ruff       — generic Python hygiene (pyproject.toml config); skipped
+#                   with a message when not installed (the container doesn't
+#                   ship it; CI images may).
+#   2. graftlint  — the pattern analyzers: taxonomy soundness, jit/trace
+#                   hygiene, native lock discipline.
+#   3. graftcheck — the CFG/dataflow tier (lint/flow/): Pallas kernel
+#                   contracts, nemesis fault↔heal pairing, resource leaks
+#                   across exception paths; gated on the checked-in
+#                   baseline (lint/baseline.json) so only REGRESSIONS fail.
+#   4. make tidy  — curated clang-tidy over native/src (self-skipping when
+#                   clang-tidy is absent, same pattern as SKIP_TSAN=1).
+# Stages 2-3 are pure stdlib (no jax import) so they never need skipping.
+# Exit nonzero on any finding. tests/test_lint.py + tests/test_lint_flow.py
+# keep stages 2-3 green by construction (self-hosting: the suite lints the
+# repo that contains it).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,8 +25,12 @@ else
     echo "== ruff: not installed — skipping (graftlint still runs) =="
 fi
 
-echo "== graftlint =="
-python -m jepsen_jgroups_raft_tpu.lint
+echo "== graftlint (pattern tier) =="
+python -m jepsen_jgroups_raft_tpu.lint --rules taxonomy,jit,lock
+
+echo "== graftcheck (CFG/dataflow tier) =="
+python -m jepsen_jgroups_raft_tpu.lint --rules kernel,heal,resource \
+    --baseline jepsen_jgroups_raft_tpu/lint/baseline.json
 
 echo "== clang-tidy =="
 make -C native tidy

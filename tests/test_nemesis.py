@@ -240,3 +240,75 @@ def test_grow_until_full_is_paced():
                     "nodes": ["n1", "n2", "n3"]},
                    {"time": t0 + int(1e9)}) is None
 
+
+
+class WedgedRemoveDB:
+    """Membership-failure fake: remove_member always wedges, and the
+    rollback start ALSO fails once — the double-failure path behind the
+    graftcheck flow-unhealed-fault finding in MemberNemesis._shrink."""
+
+    def __init__(self):
+        self.killed = set()
+        self.start_failures = 1
+        self.restarted = []
+
+    def primaries(self, test):
+        return []
+
+    def kill(self, test, node):
+        self.killed.add(node)
+
+    def start(self, test, node):
+        if self.start_failures > 0:
+            self.start_failures -= 1
+            raise RuntimeError("rollback start failed")
+        self.restarted.append(node)
+        self.killed.discard(node)
+
+    def remove_member(self, test, node):
+        raise RuntimeError("consensus remove wedged")
+
+
+def test_failed_remove_and_rollback_is_registered_and_teardown_restarts():
+    # regression for the graftcheck flow-unhealed-fault fix: when the
+    # consensus remove AND the rollback start both fail, the node used to
+    # stay a permanently-dead voting member (still in `members`, so
+    # GrowUntilFull never regrew it). Now the orphan is registered and
+    # teardown retries the restart.
+    db = WedgedRemoveDB()
+    members = set(NODES)
+    test = {"nodes": NODES, "members": members}
+    nem = MemberNemesis(db, seed=13)
+    out = nem.invoke(test, nem_op("shrink"))
+    assert "error" in out.value  # the failure became an op value
+    [victim] = sorted(db.killed)
+    assert victim in members          # never removed from the shared set
+    assert nem.unhealed == {victim}   # ...but registered for teardown
+    nem.teardown(test)
+    assert db.restarted == [victim]   # teardown retried the restart
+    assert nem.unhealed == set()
+    assert db.killed == set()
+
+
+def test_teardown_waits_for_abandoned_op_before_retrying():
+    # review fix: teardown must wait for a timed-out (abandoned) pool op
+    # to finish — that op can register into `unhealed` at its very end,
+    # and a retry loop that runs first would miss the node forever.
+    import time
+
+    class SlowWedgedDB(WedgedRemoveDB):
+        def remove_member(self, test, node):
+            time.sleep(0.3)  # outlives the op timeout below
+            raise RuntimeError("consensus remove wedged")
+
+    db = SlowWedgedDB()
+    members = set(NODES)
+    test = {"nodes": NODES, "members": members}
+    nem = MemberNemesis(db, seed=13, op_timeout=0.05)
+    out = nem.invoke(test, nem_op("shrink"))
+    assert "timed out" in out.value["error"]
+    nem.teardown(test)  # blocks on the abandoned op, then retries
+    [victim] = db.restarted
+    assert victim in members
+    assert nem.unhealed == set()
+    assert db.killed == set()

@@ -110,3 +110,60 @@ def test_worker_survives_non_client_exception(tmp_path):
     assert len(infos) == 1
     assert "ValueError" in infos[0].error
     assert len([op for op in h if op.type == OK]) == 4
+
+
+class SetupFailsClient(Client):
+    """open() succeeds but the returned connection's setup() raises —
+    the shape behind the graftcheck flow-resource-leak finding: before
+    the _open_client fix, the worker dropped the half-open connection
+    without close and continued with `client = None`."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.opened = []
+        self.closed = []
+        self.fail_setups = 1
+
+    def open(self, test, node):
+        conn = SetupFailsClient.__new__(SetupFailsClient)
+        conn.parent = self
+        with self.lock:
+            self.opened.append(conn)
+        return conn
+
+    def setup(self, test):
+        parent = self.parent
+        with parent.lock:
+            if parent.fail_setups > 0:
+                parent.fail_setups -= 1
+                raise RuntimeError("injected setup failure")
+
+    def invoke(self, test, op):
+        return op.replace(type=OK)
+
+    def close(self, test):
+        with self.parent.lock:
+            self.parent.closed.append(self)
+
+
+def test_half_open_client_closed_when_setup_fails(tmp_path):
+    # regression for the graftcheck flow-resource-leak fix in
+    # core/runner.py: a connection whose setup raised must be CLOSED
+    # before the worker falls back to client=None, and the run must
+    # still complete (the worker reconnects on the next op).
+    proto = SetupFailsClient()
+    test = run_test({
+        "name": "half-open",
+        "nodes": ["n1"],
+        "concurrency": 1,
+        "client": proto,
+        "generator": Clients(Limit(3, Repeat({"f": "write", "value": 1}))),
+        "store": False,
+    })
+    assert proto.fail_setups == 0  # the injection actually happened
+    # every opened connection was eventually closed — including the
+    # half-open one from the failed setup
+    assert set(map(id, proto.closed)) == set(map(id, proto.opened))
+    # and the run recovered: ops completed OK after the reconnect
+    assert [op.type for op in test["history"]
+            if op.type in (OK, "fail")].count(OK) >= 2
