@@ -266,6 +266,8 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         for _ in range(n_histories)
     ]
 
+    from jepsen_jgroups_raft_tpu.checker.schedule import (
+        build_dense_launches, consume_stats, run_chunked, scan_chunk)
     from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plans_grouped
     from jepsen_jgroups_raft_tpu.ops.linear_scan import bucket_slots
 
@@ -320,9 +322,40 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         t2 = time.perf_counter()
         return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
 
+    def run_chunks():
+        """ISSUE-3 chunked wavefront: per-group packing, decided-row
+        eviction between chunks, whole groups row-sharded over the
+        mesh and pipelined (checker/schedule.py build_dense_launches —
+        one home for the placement policy). JGRAFT_SCAN_CHUNK=0
+        selects the legacy monolithic mesh path in run() instead."""
+        from jepsen_jgroups_raft_tpu.checker.linearizable import (
+            _route_group_to_host)
+
+        consume_stats()  # this rep's counters only
+        t0 = time.perf_counter()
+        triples = [(idxs, plan, pack_batch([encs[i] for i in idxs]))
+                   for idxs, plan in grouped]
+        t1 = time.perf_counter()
+        launches, _ = build_dense_launches(
+            model, triples, host_route=_route_group_to_host)
+        outs = run_chunked(launches)
+        n_valid = sum(int(o.ok.sum()) for o in outs)
+        n_unknown = sum(int((~o.ok & o.overflow).sum()) for o in outs)
+        if rest:
+            _, _, nv, nu = check_batch_sharded(
+                model, pack_batch([encs[i] for i in rest])["events"],
+                mesh, n_slots=n_slots)
+            n_valid += nv
+            n_unknown += nu
+        t2 = time.perf_counter()
+        return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
+                consume_stats())
+
     def run():
         if want_pallas:
-            return run_pallas()
+            return run_pallas() + ({},)
+        if grouped and scan_chunk() > 0:
+            return run_chunks()
         t0 = time.perf_counter()
         batch = pack_batch(encs)
         t1 = time.perf_counter()
@@ -343,12 +376,12 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             n_valid += nv
             n_unknown += nu
         t2 = time.perf_counter()
-        return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
+        return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown, {}
 
     run()  # warm-up: compile
     beat()
-    (dt, dt_pack, dt_kernel, n_valid, n_unknown), rep_times = best_of(
-        run, profile_dir=os.environ.get("JGRAFT_PROFILE_DIR"))
+    (dt, dt_pack, dt_kernel, n_valid, n_unknown, scan_stats), rep_times = \
+        best_of(run, profile_dir=os.environ.get("JGRAFT_PROFILE_DIR"))
 
     if n_valid + n_unknown != n_histories or n_unknown > 0:
         # Soundness check: every synthetic history is valid by construction.
@@ -384,6 +417,17 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),
+        # Chunked-wavefront counters (checker/schedule.py; all zero when
+        # JGRAFT_SCAN_CHUNK=0 pins the legacy monolithic scan):
+        # evicted_rows = rows retired before their group's monolithic-
+        # equivalent schedule finished; pipeline_overlap_s = estimated
+        # wall time with ≥2 group kernels concurrently in flight.
+        "scan_chunk": scan_chunk() if not want_pallas else 0,
+        "evicted_rows": scan_stats.get("evicted_rows", 0),
+        "chunks_run": scan_stats.get("chunks_run", 0),
+        "groups_early_exited": scan_stats.get("groups_early_exited", 0),
+        "pipeline_overlap_s": round(
+            scan_stats.get("pipeline_overlap_s", 0.0), 3),
         # value/time_s are the best rep; the full spread stays in the
         # artifact so the tunnel's variance is never laundered away.
         "rep_times_s": [round(t, 3) for t in rep_times],
@@ -419,6 +463,8 @@ def run_suite(platform_note: str) -> None:
         return max(floor, int(n * scale))
 
     def timed(name, model, hists):
+        from jepsen_jgroups_raft_tpu.checker.schedule import consume_stats
+
         # No pinned capacity: the checker auto-routes (dense kernel where
         # the domain allows, capacity-laddered sort kernel otherwise).
         # The untimed first pass warms EXACTLY the shapes the timed pass
@@ -427,12 +473,14 @@ def run_suite(platform_note: str) -> None:
         # multi-second XLA compile.
         check_histories(hists, model, algorithm="jax")
         beat()
+        consume_stats()  # drop the warm-up's chunked-scan counters
         # Best-of-3 like the north-star bench: single-shot suite rows
         # measured the tunnel's mood (config 4 read 3.08 hist/s in the
         # same session a warm in-process A/B measured 9.5).
         rs, times = best_of(
             lambda: check_histories(hists, model, algorithm="jax"))
         dt = min(times)
+        scan = consume_stats()  # summed over the timed reps
         bad = [r for r in rs if r["valid?"] is not True]
         kernels = sorted({r.get("kernel", r["algorithm"]) for r in rs})
         emit({"config": name, "histories": len(hists),
@@ -440,6 +488,9 @@ def run_suite(platform_note: str) -> None:
               "histories_per_sec": round(len(hists) / dt, 2),
               "invalid_or_unknown": len(bad), "kernel": kernels,
               "rep_times_s": [round(t, 3) for t in times],
+              "evicted_rows": scan["evicted_rows"],
+              "chunks_run": scan["chunks_run"],
+              "pipeline_overlap_s": round(scan["pipeline_overlap_s"], 3),
               "platform": platform})
 
     rng = _random.Random(3)
@@ -568,9 +619,18 @@ def resolve_platform() -> str:
     if platform is None or platform == "cpu":
         if platform is None:
             pin_cpu()
-            return (f"cpu (platform probe failed/timed out{suffix} over "
+            note = (f"cpu (platform probe failed/timed out{suffix} over "
                     f"{RETRY_WINDOW_S:.0f} s window — TPU unreachable, "
                     "degraded to host CPU)")
+            # Mirror the note into the checker-side degrade registry so
+            # every checker result this process produces carries
+            # platform-degraded metadata, not just the bench JSON
+            # (ISSUE-3 satellite: a silently-degraded run must be
+            # distinguishable from an intended-CPU run in ALL artifacts).
+            from jepsen_jgroups_raft_tpu.platform import note_degraded
+
+            note_degraded(note)
+            return note
         pin_cpu()
         return f"cpu ({'env-pinned' if env_pin else 'default backend'})"
     kind = "env-pinned" if env_pin else "default backend"
@@ -588,6 +648,11 @@ def main() -> None:
     _start_watchdog()
     if degraded := os.environ.get("JGRAFT_BENCH_DEGRADED"):
         note += f" [degraded: first attempt failed: {degraded}]"
+        # The re-exec'd CPU run is a degraded run: stamp checker-side
+        # results too (same registry resolve_platform's probe path uses).
+        from jepsen_jgroups_raft_tpu.platform import note_degraded
+
+        note_degraded(f"re-exec on cpu after backend failure: {degraded}")
     if "--suite" in sys.argv:
         run_suite(note)
         persist_artifact("suite")

@@ -80,6 +80,13 @@ class PerfChecker(Checker):
             span = (max(buckets) - min(buckets) + 1) * self.bucket_s
             out["rate"][t] = {"mean-hz": sum(buckets.values()) / span}
         out["nemesis-windows"] = nemesis_windows
+        # Chunked event-scan counters (checker/schedule.py): how much
+        # verification work the wavefront evicted/overlapped so far this
+        # process — surfaced here so per-run stores carry the eviction
+        # evidence next to the latency data (ISSUE 3 reporting path).
+        scan = scan_stats_summary()
+        if scan is not None:
+            out["scan-stats"] = scan
         store_dir = (test or {}).get("store_dir")
         if self.render and store_dir:
             try:
@@ -90,6 +97,23 @@ class PerfChecker(Checker):
             except Exception:  # plotting must never fail a run
                 pass
         return out
+
+
+def scan_stats_summary():
+    """Snapshot of the chunked-scan wavefront counters
+    (checker/schedule.py), or None when no chunked group has run this
+    process (legacy monolithic mode, or no kernel work yet) — absent
+    beats all-zero in stored results."""
+    from .schedule import snapshot_stats
+
+    scan = snapshot_stats()
+    if not scan.get("groups_run"):
+        return None
+    return {"chunks-run": scan["chunks_run"],
+            "evicted-rows": scan["evicted_rows"],
+            "groups-run": scan["groups_run"],
+            "groups-early-exited": scan["groups_early_exited"],
+            "pipeline-overlap-s": round(scan["pipeline_overlap_s"], 3)}
 
 
 #: fault-op f → healing-op f (the start/stop convention nemesis packages

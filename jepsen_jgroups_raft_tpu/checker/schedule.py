@@ -1,0 +1,492 @@
+"""Chunked wavefront scheduler: decided-row eviction + pipelined dispatch.
+
+The ISSUE-3 tentpole. The monolithic execution path pays one padded-E
+`lax.scan` per window group, strictly serialized across groups: every
+row rides the full scan even after its verdict is certain (frontier died
+→ invalid) or its real events are exhausted (the remaining schedule is
+EV_PAD no-ops), and group k+1's kernel queues behind group k's on one
+device. That is the classic finished-sequences-in-the-batch inefficiency
+of batched inference; the fix here is the same shape as iteration-level
+(continuous) batching in serving stacks (PAPERS.md: Orca/vLLM):
+
+  * **Chunking** — the event scan advances in `chunk`-event units
+    (`JGRAFT_SCAN_CHUNK`, 0 = legacy monolithic scan) through the
+    chunked kernels of ops/dense_scan.py / ops/linear_scan.py, whose
+    carry returns per-row `decided` / `exhausted` flags alongside the
+    frontier. Sync-free spans coalesce: no row can exhaust before
+    min(alive `n_events`) — host data — so the scheduler launches one
+    kernel up to the next possible-retirement boundary instead of one
+    per chunk (`_span_chunks`; per-launch overhead otherwise eats the
+    eviction win).
+  * **Eviction** — between chunks the flags come back to the host, the
+    verdicts of finished rows are recorded, and survivors are
+    recompacted to a smaller row bucket (`history.packing.bucket_rows`,
+    the same pow2+midpoint series `pad_batch_bucketed` uses — so
+    recompaction hits jit-cache entries the initial padding already
+    compiled instead of triggering fresh XLA compiles).
+  * **Early exit** — a group stops the moment all rows are decided.
+    The chunk schedule covers the group's *bucketed* event length (what
+    the legacy monolithic kernel scans), so skipping trailing pad
+    chunks is a genuine saving over the monolithic reference, and is
+    what `early_exit` reports.
+  * **Pipelining** — each round dispatches every live group's next
+    chunk before blocking on any result (JAX async dispatch), each
+    chunk row-sharded over the device mesh
+    (`parallel.mesh.chunk_sharding`), so one group's chunk executes
+    mesh-wide exactly like the legacy `shard_map` path while the other
+    groups' chunks queue behind it on every device — the host blocking
+    on one group's flags never idles the ring.
+
+Soundness (the checker/linearizable.py contract, unchanged): eviction
+only ever removes rows whose verdict is already certain. A `decided`
+row's (ok, overflow) pair is frozen — `ok` is monotone and flips False
+exactly when the frontier empties, after which every event is a no-op on
+the dead frontier — and an `exhausted` row only has EV_PAD no-ops left.
+The scheduler maps the final pairs exactly as the monolithic caller
+does (ok → valid; ~ok & ~overflow → invalid; ~ok & overflow → escalate),
+so the chunked path can never report a verdict the monolithic scan would
+not have (pinned bitwise by tests/test_chunked_scan.py differentials).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..history.packing import bucket_rows
+from ..platform import env_int
+
+#: Default events per chunk. Calibrated on the north-star host-CPU bench
+#: shape (1000×1k register, window groups W=5..8, real event counts
+#: ~1472..1645 padded to a 2048 bucket): the win is bounded by how soon
+#: after its last real event a row is evicted, so finer chunks help
+#: until per-launch overhead bites — the calibration grid (legacy
+#: ≈ c256 ≈ 12.2 s, c128 11.6 s, c64 11.5 s on a 256-row scale model;
+#: per-launch overhead stays negligible down to 64) picks 128: most
+#: north-star rows retire at the 1536 boundary (chunk 12/16) and the
+#: rest one chunk later, vs chunk 7/8 for 256. JGRAFT_SCAN_CHUNK
+#: overrides (0 = legacy monolithic scan — the ablation hook and
+#: reference implementation).
+DEFAULT_SCAN_CHUNK = 128
+
+
+def scan_chunk() -> int:
+    """Resolved chunk size: 0 disables chunking (legacy monolithic
+    scan). Parsed defensively — a non-integer env value warns and uses
+    the default instead of crashing the importer."""
+    return env_int("JGRAFT_SCAN_CHUNK", DEFAULT_SCAN_CHUNK, minimum=0)
+
+
+# ------------------------------------------------------------------ stats
+# Aggregated across every wavefront this process runs (thread-safe: race
+# mode drives the jax pass from a worker thread). bench.py consumes them
+# per rep; checker/perf.py snapshots them into its result metadata.
+
+_STATS_LOCK = threading.Lock()
+_STATS_ZERO = {"chunks_run": 0, "evicted_rows": 0, "groups_run": 0,
+               "groups_early_exited": 0, "pipeline_overlap_s": 0.0}
+_STATS = dict(_STATS_ZERO)
+
+
+def _add_stats(**kw) -> None:
+    with _STATS_LOCK:
+        for k, v in kw.items():
+            _STATS[k] += v
+
+
+def snapshot_stats() -> dict:
+    """Copy of the accumulated chunked-scan counters (non-destructive)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def consume_stats() -> dict:
+    """Return and reset the accumulated counters (bench.py reads one
+    timed rep's worth at a time)."""
+    global _STATS
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        _STATS = dict(_STATS_ZERO)
+        return out
+
+
+# ------------------------------------------------------------- wavefront
+
+
+@dataclass
+class ChunkLaunch:
+    """One window group queued for chunked execution.
+
+    events: [B, E, 5] packed group batch (host numpy; pack_batch layout).
+    n_events: [B] real event count per row (EncodedHistory.n_events).
+    init_fn/step_fn: the chunked kernel pair from
+        ops.dense_scan.make_dense_chunk_checker or
+        ops.linear_scan.make_sort_chunk_checker.
+    val_of: [B, S] per-history domain table (dense kernels) or None
+        (sort kernel — its init_fn takes only n_events).
+    e_sched: event length the chunk schedule must cover — the BUCKETED
+        length the legacy monolithic kernel would scan (so early exit
+        measures real savings vs the reference path); defaults to E.
+    device: placement for this group's carry + chunk slices — a jax
+        Device (host-routed groups), a batch-axis Sharding
+        (`parallel.mesh.chunk_sharding`: rows spread over the mesh,
+        row buckets padded to a multiple of the shard count), or None
+        for default single-device placement.
+    tag: kernel label for result/bench reporting.
+    """
+
+    events: np.ndarray
+    n_events: np.ndarray
+    init_fn: Callable
+    step_fn: Callable
+    val_of: Optional[np.ndarray] = None
+    e_sched: Optional[int] = None
+    device: Optional[object] = None
+    tag: str = "dense-chunk"
+    #: LONG merged clusters keep exact row counts (the legacy path pads
+    #: floor_b=len(sub) for them: extra rows are pure width work on a
+    #: depth-bound launch) and skip recompaction — their row counts are
+    #: tiny, so eviction's value there is the early exit, not bucket
+    #: shrinking, and per-eviction exact shapes would recompile.
+    exact_rows: bool = False
+
+
+@dataclass
+class GroupOutcome:
+    """Per-group result of a wavefront run; ok/overflow are [B_real].
+    `chunks_run` counts LAUNCHES — sync-free spans are coalesced into
+    one launch each (`_span_chunks`), so it is ≤ the chunk-unit count
+    the schedule covers."""
+
+    ok: np.ndarray
+    overflow: np.ndarray
+    wall_s: float
+    chunks_run: int
+    evicted_rows: int
+    early_exit: bool
+    tag: str = ""
+
+
+@dataclass
+class _GroupState:
+    launch: ChunkLaunch
+    padded_events: np.ndarray          # [B_real, E_pad, 5]
+    scheduled: int                     # chunks the monolithic path implies
+    slot_rows: np.ndarray              # [padded_B] original row id or -1
+    carry: object                      # device pytree
+    ok: np.ndarray                     # [B_real] final verdicts
+    overflow: np.ndarray
+    recorded: np.ndarray               # [B_real] bool
+    cursor: int = 0                    # chunk-units already scanned
+    launches_run: int = 0              # coalesced launches dispatched
+    evicted: int = 0
+    done: bool = False
+    early_exit: bool = False
+    t_start: float = 0.0
+    wall_s: float = 0.0
+    pending: Optional[tuple] = None
+    intervals: List[tuple] = field(default_factory=list)  # in-flight spans
+
+
+def build_dense_launches(model, groups, host_route=None):
+    """Build the wavefront launch list for dense window groups — the
+    one home of the placement policy (checker/_jax_pass and
+    bench.run_chunks both route through it).
+
+    groups: iterable of (rows, plan, batch) — `rows` the caller's row
+    ids, `plan` a DensePlan, `batch` the group's pack_batch dict. The
+    launch order is policy and lives HERE: largest group first, so big
+    groups' chunks queue ahead of small ones on every device (callers
+    must not pre-sort — the bench and the checker must measure the
+    same schedule).
+    host_route(n_rows_bucketed, e_len) -> bool optionally routes a
+    whole group to the host cpu device (the PLATFORM_ROUTE_MIN_CELLS
+    gate). Returns (launches, subs): subs[k] holds the row ids behind
+    launches[k], in row order.
+
+    Groups stay WHOLE and each chunk's kernel is an explicit
+    `shard_map` over the batch axis of the device mesh
+    (`parallel.mesh.chunk_sharding`; the wrap lives in
+    ops/dense_scan._shard_chunk_fns): every device scans its row shard
+    — the exact execution shape of the legacy `shard_map` path, whose
+    row-parallelism is the measured win on every backend (2-core
+    north-star A/B: mesh-sharded 116 s vs 250 s single-device
+    monolithic). Two cheaper-looking alternatives lost: Python-level
+    per-device group *slicing* reached only ~1.4–1.6× overlap with
+    round-robin collect bubbles, and relying on jit's GSPMD sharding
+    propagation kept the carry *placed* sharded but compiled a ~3×
+    slower per-chunk program than the explicit wrap. Cross-group
+    pipelining comes free: all live groups' chunks queue on every
+    device, so the host blocking on one group's flags never idles the
+    ring. LONG merged clusters (exact_rows) keep exact row counts on
+    the default device — depth-bound few-row launches, sharding buys
+    nothing — and host-routed groups pin whole to the host cpu
+    device."""
+    from ..ops.dense_scan import MERGE_MAX_EVENTS, make_dense_chunk_checker
+    from ..parallel.mesh import chunk_sharding
+
+    sharding = chunk_sharding()
+    mesh = getattr(sharding, "mesh", None)
+    launches: list = []
+    subs: list = []
+    for rows, plan, batch in sorted(groups, key=lambda g: -len(g[0])):
+        e_len = batch["events"].shape[1]
+        exact = e_len > MERGE_MAX_EVENTS
+        e_sched = e_len if exact else bucket_rows(e_len, 32)
+        tag = plan.kernel_tag
+        # Gate on the same PADDED shapes the legacy path feeds
+        # _route_group_to_host (pad_batch_bucketed's row bucket and
+        # floor_e=32 event bucket — e_sched IS that bucket for
+        # non-LONG groups): an unbucketed e_len would flip routing
+        # for groups near the PLATFORM_ROUTE_MIN_CELLS boundary.
+        host = bool(host_route
+                    and host_route(bucket_rows(len(rows)), e_sched))
+        if host:
+            import jax
+
+            tag += "@host"
+            placement = jax.devices("cpu")[0]
+        else:
+            placement = None if exact else sharding
+        init_fn, step_fn = make_dense_chunk_checker(
+            model, plan.kind, plan.n_slots, plan.n_states,
+            mesh=mesh if placement is sharding else None)
+        launches.append(ChunkLaunch(
+            events=batch["events"], n_events=batch["n_events"],
+            init_fn=init_fn, step_fn=step_fn, val_of=plan.val_of,
+            e_sched=e_sched, device=placement, tag=tag,
+            exact_rows=exact))
+        subs.append(list(rows))
+    return launches, subs
+
+
+def _n_shards(placement) -> int:
+    """Shard count of a launch placement: mesh size for a batch-axis
+    Sharding, 1 for a concrete device or default placement."""
+    mesh = getattr(placement, "mesh", None)
+    return int(mesh.size) if mesh is not None else 1
+
+
+def _bucket_launch_rows(launch: ChunkLaunch, n: int) -> int:
+    """Row bucket for a launch's active set: the pow2+midpoint series,
+    padded up to a multiple of the placement's shard count so a
+    sharded launch always splits evenly over the mesh (the same
+    rounding `pad_batch_bucketed(multiple_b=mesh)` applies on the
+    legacy sharded path)."""
+    b = bucket_rows(n)
+    s = _n_shards(launch.device)
+    return -(-b // s) * s
+
+
+def _pad_idx(positions: List[int], bucket: int) -> np.ndarray:
+    """Gather index padded to the bucket by repeating the first entry
+    (pad slots are masked out of every flag read via slot_rows == -1)."""
+    idx = np.asarray(positions + [positions[0]] * (bucket - len(positions)),
+                     dtype=np.int32)
+    return idx
+
+
+def _init_group(launch: ChunkLaunch, chunk: int) -> _GroupState:
+    import jax
+
+    B, E = launch.events.shape[0], launch.events.shape[1]
+    e_sched = max(launch.e_sched or E, E, 1)
+    e_pad = ((e_sched + chunk - 1) // chunk) * chunk
+    padded = launch.events
+    if e_pad != E:
+        padded = np.zeros((B, e_pad, 5), dtype=launch.events.dtype)
+        padded[:, :E] = launch.events
+    padded_b = B if launch.exact_rows else _bucket_launch_rows(launch, B)
+    slot_rows = np.full((padded_b,), -1, dtype=np.int32)
+    slot_rows[:B] = np.arange(B, dtype=np.int32)
+
+    ne = np.zeros((padded_b,), dtype=np.int32)
+    ne[:B] = launch.n_events
+    put = (lambda x: jax.device_put(x, launch.device)) \
+        if launch.device is not None else (lambda x: x)
+    if launch.val_of is not None:
+        vo = np.empty((padded_b,) + launch.val_of.shape[1:],
+                      dtype=launch.val_of.dtype)
+        vo[:B] = launch.val_of
+        vo[B:] = launch.val_of[:1]
+        carry = launch.init_fn(put(vo), put(ne))
+    else:
+        carry = launch.init_fn(put(ne))
+    return _GroupState(
+        launch=launch, padded_events=padded, scheduled=e_pad // chunk,
+        slot_rows=slot_rows, carry=carry,
+        ok=np.zeros((B,), dtype=bool), overflow=np.zeros((B,), dtype=bool),
+        recorded=np.zeros((B,), dtype=bool), t_start=time.perf_counter())
+
+
+def _chunk_slice(g: _GroupState, lo: int, width: int) -> np.ndarray:
+    """[padded_B, width, 5] host slice for the next launch: each slot's
+    mapped row's events (zeros for pad slots — EV_PAD no-ops)."""
+    rows = np.maximum(g.slot_rows, 0)
+    # Advanced indexing already materializes a fresh array, so the pad
+    # slots can be zeroed in place.
+    ev = g.padded_events[rows, lo:lo + width]
+    if (g.slot_rows < 0).any():
+        ev[g.slot_rows < 0] = 0
+    return ev
+
+
+def _span_chunks(g: _GroupState, chunk: int) -> int:
+    """How many chunks the next launch coalesces. Flag syncs only pay
+    for themselves at boundaries where a row can actually retire, and
+    exhaustion is host-predictable: `n_events` is host data, so no live
+    row can exhaust before min(alive `n_events`). The span therefore
+    jumps to the first possible-retirement boundary in ONE launch
+    instead of one launch per chunk — on the north-star shape that
+    collapses ~11 sync-free launches per group into 2, and per-launch
+    dispatch overhead (multi-device rendezvous, flag readback) was
+    measured to eat the entire eviction win when paid per chunk. The
+    span is rounded DOWN to a power-of-two multiple of the chunk so
+    launch shapes stay in a bounded set ({chunk·2^k} × the row-bucket
+    series) that hits the jit cache across groups. Soundness: a
+    `decided` (~ok) row inside a coalesced span is caught at the next
+    sync — its verdict is frozen (see module docstring), so it is
+    recorded late, never differently; only eviction latency moves."""
+    live = g.slot_rows[g.slot_rows >= 0]
+    live = live[~g.recorded[live]]
+    lo = g.cursor * chunk
+    first = int(g.launch.n_events[live].min()) if live.size else 0
+    p = max(1, -(-(first - lo) // chunk))  # ceil, ≥1 once overdue
+    p = min(p, g.scheduled - g.cursor)
+    return 1 << (p.bit_length() - 1) if p > 1 else 1
+
+
+def _dispatch(g: _GroupState, chunk: int) -> None:
+    import jax
+
+    span = _span_chunks(g, chunk)
+    ev = _chunk_slice(g, g.cursor * chunk, span * chunk)
+    if g.launch.device is not None:
+        ev = jax.device_put(ev, g.launch.device)
+    t0 = time.perf_counter()
+    g.pending = (t0, span, g.launch.step_fn(g.carry, ev))
+
+
+def _collect(g: _GroupState, chunk: int) -> None:
+    """Block for the pending launch, record finished rows, evict, and
+    recompact survivors when they fit a smaller row bucket."""
+    import jax
+
+    t_disp, span, (carry, decided, exhausted, ok, overflow) = g.pending
+    g.pending = None
+    g.carry = carry
+    # blocks: device → host (the wavefront's per-round sync point)
+    decided = np.asarray(decided)      # lint: allow(host-sync)
+    exhausted = np.asarray(exhausted)  # lint: allow(host-sync)
+    ok = np.asarray(ok)                # lint: allow(host-sync)
+    overflow = np.asarray(overflow)    # lint: allow(host-sync)
+    g.intervals.append((t_disp, time.perf_counter()))
+    g.cursor += span
+    g.launches_run += 1
+
+    real = g.slot_rows >= 0
+    finished = (decided | exhausted) & real
+    rows = g.slot_rows[finished]
+    fresh = rows[~g.recorded[rows]]
+    if fresh.size:
+        pos = np.flatnonzero(finished)[~g.recorded[rows]]
+        g.ok[fresh] = ok[pos]
+        g.overflow[fresh] = overflow[pos]
+        g.recorded[fresh] = True
+        if g.cursor < g.scheduled:
+            g.evicted += int(fresh.size)
+
+    alive = np.flatnonzero(real & ~(decided | exhausted))
+    alive = alive[~g.recorded[g.slot_rows[alive]]]
+    if alive.size == 0 or g.cursor >= g.scheduled:
+        # Defensive tail: every row's events fit the schedule, so an
+        # un-recorded row at schedule end cannot happen — but if it did,
+        # its current verdict is the monolithic one (only EV_PAD left).
+        left = g.slot_rows[alive] if alive.size else \
+            np.empty((0,), np.int32)
+        for p, r in zip(alive, left):
+            if not g.recorded[r]:
+                g.ok[r], g.overflow[r] = ok[p], overflow[p]
+                g.recorded[r] = True
+        g.done = True
+        g.early_exit = g.cursor < g.scheduled
+        g.wall_s = time.perf_counter() - g.t_start
+        return
+
+    if g.launch.exact_rows:
+        return  # no recompaction (see ChunkLaunch.exact_rows)
+    bucket = _bucket_launch_rows(g.launch, int(alive.size))
+    if bucket < g.slot_rows.shape[0]:
+        idx = _pad_idx([int(p) for p in alive], bucket)
+        g.carry = jax.tree_util.tree_map(lambda x: x[idx], g.carry)
+        if g.launch.device is not None:
+            # Re-pin the gathered carry to the launch placement: the
+            # eager gather does not preserve the batch-axis sharding,
+            # and the next step_fn call must split evenly again.
+            g.carry = jax.device_put(g.carry, g.launch.device)
+        new_rows = np.full((bucket,), -1, dtype=np.int32)
+        new_rows[: alive.size] = g.slot_rows[alive]
+        g.slot_rows = new_rows
+
+
+def _overlap_seconds(intervals: List[tuple]) -> float:
+    """Total wall time during which ≥2 group chunks were in flight —
+    estimated from (dispatch, collect) spans; collects happen in round
+    order so this is an upper-bound estimate, reported as such."""
+    events = []
+    for a, b in intervals:
+        events.append((a, 1))
+        events.append((b, -1))
+    events.sort()
+    depth = 0
+    overlap = 0.0
+    prev = None
+    for t, d in events:
+        if prev is not None and depth >= 2:
+            overlap += t - prev
+        depth += d
+        prev = t
+    return overlap
+
+
+def run_chunked(launches: List[ChunkLaunch],
+                chunk: Optional[int] = None) -> List[GroupOutcome]:
+    """Run window groups through the chunked wavefront; one
+    GroupOutcome per launch, in order. Each round dispatches every live
+    group's next chunk before blocking on any result, so group kernels
+    overlap on their per-group devices (JAX async dispatch)."""
+    chunk = scan_chunk() if chunk is None else chunk
+    if chunk <= 0:
+        raise ValueError("run_chunked needs a positive chunk size "
+                         "(JGRAFT_SCAN_CHUNK=0 selects the legacy "
+                         "monolithic path at the call site)")
+    groups = [_init_group(ln, chunk) for ln in launches]
+    for g in groups:
+        _dispatch(g, chunk)
+    while True:
+        live = [g for g in groups if not g.done]
+        if not live:
+            break
+        for g in live:
+            _collect(g, chunk)
+            if not g.done:
+                # Refill this launch's device queue BEFORE collecting
+                # the next one (streaming, not bulk-synchronous): a
+                # round barrier would drain every device queue while
+                # the host walks the collect order, and the bubble is
+                # pure loss on both the tunnel and the host.
+                _dispatch(g, chunk)
+    all_spans = [iv for g in groups for iv in g.intervals]
+    _add_stats(chunks_run=sum(g.launches_run for g in groups),
+               evicted_rows=sum(g.evicted for g in groups),
+               groups_run=len(groups),
+               groups_early_exited=sum(1 for g in groups if g.early_exit),
+               pipeline_overlap_s=_overlap_seconds(all_spans))
+    return [GroupOutcome(ok=g.ok, overflow=g.overflow, wall_s=g.wall_s,
+                         chunks_run=g.launches_run, evicted_rows=g.evicted,
+                         early_exit=g.early_exit, tag=g.launch.tag)
+            for g in groups]

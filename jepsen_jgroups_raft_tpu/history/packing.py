@@ -352,6 +352,15 @@ def pad_batch_bucketed(events: np.ndarray, tables=(), floor_b: int = 8,
     return events, out_tables, B
 
 
+def bucket_rows(n: int, floor: int = 8) -> int:
+    """Public face of the pow2+midpoint bucket series for ROW counts —
+    the chunked-scan scheduler (checker/schedule.py) recompacts a
+    shrinking active set through these exact buckets so every
+    recompaction hits a jit-cache entry the initial padding already
+    compiled, instead of triggering a fresh XLA compile per eviction."""
+    return _bucket_pow2(n, floor)
+
+
 def _bucket_pow2(n: int, floor: int) -> int:
     """Next bucket ≥ n from the series floor·{1, 1.5, 2, 3, 4, 6, 8…}
     (powers of two plus their midpoints): padding waste is capped at

@@ -74,8 +74,12 @@ class Contract:
     where: Optional[callable] = None
     #: (expr over module constants, max value, message) rows checked once.
     const_asserts: List[Tuple[str, int, str]] = field(default_factory=list)
-    #: optional callable(interp) -> List[str] for file-specific budget
-    #: invariants that need to *run* module functions.
+    #: optional callable(interp) -> list of messages for file-specific
+    #: budget invariants that need to *run* module functions. Each item
+    #: is a bare message (emitted as kernel-vmem-budget) or an explicit
+    #: (rule, message) pair — unresolved accounting must use
+    #: ("kernel-unresolved", ...) so it stays loud under a
+    #: kernel-vmem-budget baseline.
     custom: Optional[callable] = None
 
 
@@ -102,6 +106,53 @@ def _pallas_scan_tile_budget(interp: Interp) -> List[str]:
     return out
 
 
+def _dense_chunk_budget(interp: Interp) -> List[str]:
+    """The chunked entry points (ISSUE 3) carry per-row scan state
+    between kernel launches instead of rebuilding it — so the carry
+    itself must fit the VMEM envelope at the eligibility caps.
+    Executes `dense_chunk_carry_bytes` statically over the cap corners
+    (the same loud-not-silent stance as the Pallas tile invariant)."""
+    out = []
+    fn = interp.functions.get("dense_chunk_carry_bytes")
+    caps_w = interp.module_env.get("DENSE_MAX_SLOTS")
+    caps_s = interp.module_env.get("DENSE_MAX_STATES")
+    mask_w = interp.module_env.get("MASK_DENSE_MAX_SLOTS")
+    if fn is None or not all(isinstance(v, int)
+                             for v in (caps_w, caps_s, mask_w)):
+        return [("kernel-unresolved",
+                 "dense_chunk_carry_bytes / dense caps not resolvable")]
+    for W, S in ((1, 1), (caps_w, 1), (caps_w, caps_s), (mask_w, 1)):
+        n = interp.exec_fn(fn, {"n_slots": W, "n_states": S})
+        if not isinstance(n, int):
+            out.append(("kernel-unresolved",
+                        f"dense_chunk_carry_bytes({W}, {S}) not evaluable"))
+        elif n > 16 << 20:
+            out.append(f"chunked dense carry at (W={W}, S={S}) = {n} B "
+                       "exceeds usable per-core VMEM")
+    return out
+
+
+def _sort_chunk_budget(interp: Interp) -> List[str]:
+    """Same invariant for the sort kernel's chunked carry, at the
+    default capacity and the hard window cap."""
+    out = []
+    fn = interp.functions.get("sort_chunk_carry_bytes")
+    n_cfg = interp.module_env.get("DEFAULT_N_CONFIGS")
+    n_slots = interp.module_env.get("MAX_SLOTS")
+    if fn is None or not all(isinstance(v, int) for v in (n_cfg, n_slots)):
+        return [("kernel-unresolved",
+                 "sort_chunk_carry_bytes / sort caps not resolvable")]
+    for C, W in ((n_cfg, 1), (n_cfg, n_slots), (4 * n_cfg, n_slots)):
+        n = interp.exec_fn(fn, {"n_configs": C, "n_slots": W})
+        if not isinstance(n, int):
+            out.append(("kernel-unresolved",
+                        f"sort_chunk_carry_bytes({C}, {W}) not evaluable"))
+        elif n > 16 << 20:
+            out.append(f"chunked sort carry at (C={C}, W={W}) = {n} B "
+                       "exceeds usable per-core VMEM")
+    return out
+
+
 CONTRACTS: Dict[str, Contract] = {
     "ops/pallas_scan.py": Contract(
         symbols={"W": (5,), "S": (1, 4, 16), "E": (8, 64, 512),
@@ -124,7 +175,15 @@ CONTRACTS: Dict[str, Contract] = {
          "dense cell cap exceeds VMEM"),
         ("(1 << MASK_DENSE_MAX_SLOTS) * 8", 16 << 20,
          "mask frontier + subset-sum lane at the cap exceeds VMEM"),
-    ]),
+    ], custom=_dense_chunk_budget),
+    "ops/linear_scan.py": Contract(const_asserts=[
+        # 4 mask words must keep a spare top bit for the all-ones
+        # empty-entry sentinel (module docstring soundness argument).
+        ("MAX_SLOTS", 127,
+         "window cap would consume the sentinel bit of the last word"),
+        ("DEFAULT_N_CONFIGS * ((MAX_SLOTS // 32 + 1) * 4 + 4)", 16 << 20,
+         "sort frontier at the default capacity exceeds VMEM"),
+    ], custom=_sort_chunk_budget),
     "ops/segment_scan.py": Contract(const_asserts=[
         ("MAX_BASIS * DENSE_MAX_CELLS * 4", 16 << 20,
          "segment seed-basis frontier at the caps exceeds VMEM"),
@@ -460,8 +519,15 @@ def analyze_source(src: SourceFile,
                 f"{expr} = {val} > {limit}: {msg}"))
 
     if contract.custom is not None:
-        for msg in contract.custom(interp):
-            findings.append(Finding(src.path, 1, "kernel-vmem-budget", msg))
+        # Custom analyzers yield either a bare message (a budget
+        # violation) or an explicit (rule, message) pair — unresolved
+        # accounting must surface under kernel-unresolved, the loud
+        # could-not-evaluate rule, so baselining kernel-vmem-budget
+        # can never swallow a vanished accounting fn.
+        for item in contract.custom(interp):
+            rule, msg = (item if isinstance(item, tuple)
+                         else ("kernel-vmem-budget", item))
+            findings.append(Finding(src.path, 1, rule, msg))
 
     for call, chain in _enclosing_chain(tree):
         for rule, msg in _check_call(call, chain, contract, interp,

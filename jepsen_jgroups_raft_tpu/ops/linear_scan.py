@@ -130,11 +130,14 @@ def _dedup_compact(masks, states, tags, n_configs):
     return out_m, out_s, jnp.minimum(count, C), count > C, grew
 
 
-def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
-                         n_slots: int = MAX_SLOTS):
-    """Build a jittable single-history checker.
+def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
+                    n_slots: int = MAX_SLOTS):
+    """The sort kernel decomposed for chunked execution: returns
+    (init, scan_step, verdict) with `init() -> carry`, the per-event
+    `scan_step`, and `verdict(carry) -> (valid, overflow)`. The
+    monolithic checker and the chunked wavefront (checker/schedule.py)
+    both drive this one step body, so they cannot diverge semantically.
 
-    Returns fn(events:[E,5] int32) -> (valid: bool, overflow: bool).
     `model` supplies the vectorized `jax_step` and initial state; `n_configs`
     (C) and `n_slots` (W ≤ MAX_SLOTS) fix the kernel shape.
     """
@@ -243,22 +246,38 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
         return (cleared_m, states, slot_f, slot_a, slot_b, slot_open,
                 ok, overflow, dirty), None
 
-    def check(events):
+    def init():
         masks = jnp.full((C, K), _SENT, dtype=jnp.uint32).at[0].set(
             jnp.zeros((K,), dtype=jnp.uint32))
         states = jnp.zeros((C,), dtype=jnp.int32).at[0].set(init_state)
-        carry = (
+        return (
             masks, states,
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), jnp.int32),
             jnp.zeros((W,), jnp.int32), jnp.zeros((W,), bool),
             jnp.bool_(True), jnp.bool_(False), jnp.bool_(False),
         )
-        carry, _ = lax.scan(scan_step, carry, events,
-                            unroll=scan_unroll())
-        ok, overflow = carry[6], carry[7]
+
+    def verdict(carry):
         # An overflowed run may have dropped configurations: a "False" can
         # be a false negative, so report unknown instead (caller escalates).
-        return ok, overflow
+        return carry[6], carry[7]
+
+    return init, scan_step, verdict
+
+
+def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
+                         n_slots: int = MAX_SLOTS):
+    """Build a jittable single-history checker.
+
+    Returns fn(events:[E,5] int32) -> (valid: bool, overflow: bool).
+    See `sort_step_parts` for the kernel mechanics and shape knobs.
+    """
+    init, scan_step, verdict = sort_step_parts(model, n_configs, n_slots)
+
+    def check(events):
+        carry, _ = lax.scan(scan_step, init(), events,
+                            unroll=scan_unroll())
+        return verdict(carry)
 
     return check
 
@@ -298,3 +317,74 @@ def make_batch_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
             fn = jax.jit(fn)
         _KERNEL_CACHE[key] = fn
     return fn
+
+
+def sort_chunk_carry_bytes(n_configs: int, n_slots: int) -> int:
+    """Conservative per-row resident bytes of the chunked sort carry:
+    masks [C, K] uint32 + states [C] int32 + slot registers + flags +
+    the events_left lane. Pure arithmetic — executed statically by the
+    kernel-contract analyzer at (DEFAULT_N_CONFIGS, MAX_SLOTS) to pin
+    the chunked entry point's VMEM envelope."""
+    k = n_slots // 32 + 1
+    return (n_configs * k * 4 + n_configs * 4   # masks + states
+            + 3 * n_slots * 4 + n_slots         # slot regs + open
+            + 8)                                # ok/overflow/dirty/left
+
+
+def make_sort_chunk_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
+                            n_slots: int = MAX_SLOTS, jit: bool = True,
+                            mesh=None):
+    """Chunked twin of `make_batch_checker` for the wavefront scheduler
+    (checker/schedule.py). Returns (init_fn, step_fn):
+
+      init_fn(n_events [B] int32) -> carry (batch-leading pytree)
+      step_fn(carry, events [B,chunk,5]) -> (carry', decided [B],
+          exhausted [B], ok [B], overflow [B])
+
+    Eviction soundness, sort-kernel flavor: `ok` is monotone and flips
+    False exactly when the frontier empties — after which expansion
+    produces no candidates, so `overflow` is frozen too. A `~ok` row's
+    final (ok, overflow) pair is therefore already known mid-scan:
+    (False, False) is a certain INVALID, (False, True) a certain
+    escalate-to-CPU. `exhausted` rows (events_left ≤ 0) only have
+    EV_PAD no-ops left, so their current pair is final as well. The
+    scheduler maps the pairs exactly as the monolithic caller does —
+    eviction never invents a verdict the monolithic scan would not
+    have reported.
+
+    `mesh`: wrap both fns in an explicit batch-axis `shard_map` (see
+    ops/dense_scan._shard_chunk_fns — jit sharding propagation compiles
+    a measurably slower program than the explicit wrap); callers pad
+    the batch to a multiple of the mesh size."""
+    key = ("chunk", *model.cache_key(), int(n_configs), int(n_slots), jit,
+           scan_unroll(), mesh)
+    fns = _KERNEL_CACHE.get(key)
+    if fns is None:
+        init, scan_step, verdict = sort_step_parts(model, n_configs,
+                                                   n_slots)
+
+        def init_one(n_ev):
+            return {"inner": init(),
+                    "left": jnp.asarray(n_ev, jnp.int32)}
+
+        def step_one(carry, events):
+            inner, _ = lax.scan(scan_step, carry["inner"], events,
+                                unroll=scan_unroll())
+            left = carry["left"] - events.shape[0]
+            ok, overflow = verdict(inner)
+            return ({"inner": inner, "left": left},
+                    ~ok, left <= 0, ok, overflow)
+
+        init_fn = jax.vmap(init_one)
+        step_fn = jax.vmap(step_one)
+        if mesh is not None:
+            from .dense_scan import _shard_chunk_fns
+
+            init_fn, step_fn = _shard_chunk_fns(init_fn, step_fn, mesh,
+                                                n_init_args=1)
+        if jit:
+            init_fn = jax.jit(init_fn)
+            step_fn = jax.jit(step_fn)
+        fns = (init_fn, step_fn)
+        _KERNEL_CACHE[key] = fns
+    return fns

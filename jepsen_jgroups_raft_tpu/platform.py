@@ -11,8 +11,57 @@ __graft_entry__.dryrun_multichip.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+#: Why this process is NOT running on the platform it was asked for (TPU
+#: probe failed, tunnel dropped mid-flight, ...). None when the platform
+#: in use is the intended one. Checker results carry this note so a run
+#: that silently degraded to the host is distinguishable from an
+#: intended-CPU run (the bench learned this distinction in round 5:
+#: BENCH_r05.json's platform_note existed only in the bench JSON, never
+#: in the checker's own result metadata).
+_DEGRADED_NOTE: Optional[str] = None
+
+
+def note_degraded(note: str) -> None:
+    """Record that the platform silently degraded (first note wins: the
+    root cause, not the retry cascade)."""
+    global _DEGRADED_NOTE
+    if _DEGRADED_NOTE is None:
+        _DEGRADED_NOTE = note
+
+
+def degraded_note() -> Optional[str]:
+    """The degrade reason recorded by `note_degraded`, or None."""
+    return _DEGRADED_NOTE
+
+
+def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
+    """Parse an integer env gate defensively: a non-integer value warns
+    and falls back to the default instead of crashing at import time
+    (`JGRAFT_ROUTE_MIN_CELLS=yes` used to kill every importer of
+    checker/linearizable.py with a ValueError). `minimum` clamps with a
+    warning — the gates this serves are counts/sizes where a negative
+    or undersized value is always operator error, never intent."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = int(raw.strip())
+    except ValueError:
+        _log.warning("%s=%r is not an integer; using default %d",
+                     name, raw, default)
+        return default
+    if minimum is not None and val < minimum:
+        _log.warning("%s=%d below minimum %d; clamping",
+                     name, val, minimum)
+        return minimum
+    return val
 
 
 def pin_cpu(n_devices: int = 8) -> None:
