@@ -141,6 +141,44 @@ def fail(msg: str, **extra) -> None:
           "vs_baseline": 0.0, "error": msg, **extra})
 
 
+def host_fingerprint() -> dict:
+    """Host identity stamped into every bench JSON row so future
+    `vs_baseline` comparisons can DETECT host drift instead of being
+    silently dominated by it — the ISSUE-3 postmortem: BENCH_r05's
+    24.86 hist/s was unreproducible a round later because the host
+    envelope itself had drifted ~2.9×, and nothing in the artifact
+    could show it. cpu_count + loadavg catch a busy/shrunken host;
+    jax/jaxlib versions catch a toolchain swap."""
+    try:
+        import jax
+        jax_v = jax.__version__
+    except Exception:  # noqa: BLE001 — fingerprinting must never fail
+        jax_v = "?"
+    try:
+        import jaxlib
+        jaxlib_v = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        jaxlib_v = "?"
+    try:
+        load1, load5, _ = os.getloadavg()
+    except OSError:  # not available on this platform
+        load1 = load5 = -1.0
+    return {"cpu_count": os.cpu_count(), "loadavg_1m": round(load1, 2),
+            "loadavg_5m": round(load5, 2), "jax": jax_v,
+            "jaxlib": jaxlib_v}
+
+
+def cold_warm(rep_times: list) -> dict:
+    """Cold-vs-warm split of a best_of rep list: the first timed rep
+    (coldest — caches/allocators still settling even after the compile
+    warm-up) vs the min of the later reps. A widening cold/warm gap in
+    stored artifacts flags a drifting host where a bare best-rep number
+    would hide it."""
+    return {"cold_rep_s": round(rep_times[0], 3),
+            "warm_rep_s": round(min(rep_times[1:]) if len(rep_times) > 1
+                                else rep_times[0], 3)}
+
+
 # ---- mid-run wedge watchdog -------------------------------------------
 # The start-time probe and the init-failure re-exec cover a tunnel that
 # is down BEFORE the first kernel runs. The 2026-07-31 session hit the
@@ -249,7 +287,8 @@ def best_of(fn, profile_dir: str | None = None):
 def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     import jax
 
-    from jepsen_jgroups_raft_tpu.history.packing import encode_history, pack_batch
+    from jepsen_jgroups_raft_tpu.history.packing import (
+        encode_history, macro_events_on, pack_batch, pack_macro_batch)
     from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
     from jepsen_jgroups_raft_tpu.models.register import CasRegister
     from jepsen_jgroups_raft_tpu.parallel.distributed import maybe_init_distributed
@@ -284,6 +323,38 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     # the env knob silently measured dense twice (caught by the first
     # on-chip certification, bench_runs/certify_20260731T005939).
     want_pallas = os.environ.get("JGRAFT_KERNEL") == "pallas"
+    # Macro-event compaction (ISSUE-4): the bench measures the same
+    # stream the checker routes — JGRAFT_MACRO_EVENTS=0 is the legacy
+    # one-event-per-step ablation. `scan_steps` (summed per-history
+    # stream rows the kernels semantically scan — #FORCEs + spill under
+    # macro, every event under legacy) lands in the JSON so the
+    # acceptance "scan length dropped to #FORCEs + spill" is auditable.
+    use_macro = macro_events_on()
+    _group_pack = pack_macro_batch if use_macro else pack_batch
+    legacy_steps = sum(e.n_events for e in encs)
+
+    def pack_run_inputs():
+        """One home for the macro/legacy packing rule run() and
+        run_pallas() share (run_chunks packs per-triple dicts for
+        build_dense_launches instead): (group_batches, rest_events,
+        scan_steps). Under macro, grouped rows read ONLY the macro
+        packs — legacy-packing the whole batch would double-pack every
+        grouped history inside the timed region and skew the A/B — so
+        just the sort-routed `rest` rows are legacy-packed."""
+        if use_macro:
+            gbs = [_group_pack([encs[i] for i in idxs])
+                   for idxs, _ in grouped]
+            rest_ev = (pack_batch([encs[i] for i in rest])["events"]
+                       if rest else None)
+            steps = (sum(int(b["n_events"].sum()) for b in gbs)
+                     + sum(encs[i].n_events for i in rest))
+        else:
+            batch = pack_batch(encs)
+            gbs = [{"events": batch["events"][idxs]}
+                   for idxs, _ in grouped]
+            rest_ev = batch["events"][rest] if rest else None
+            steps = legacy_steps
+        return gbs, rest_ev, steps
 
     def run_pallas():
         from jepsen_jgroups_raft_tpu.history.packing import (
@@ -295,18 +366,19 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
 
         interpret = jax.default_backend() != "tpu"  # CPU: interpreter
         t0 = time.perf_counter()
-        batch = pack_batch(encs)
+        group_batches, rest_events, scan_steps = pack_run_inputs()
         t1 = time.perf_counter()
         # Launch every group's kernel (lazy device arrays), block once
         # after the loop — same pipelining discipline as the dense path,
         # so the ablation compares kernels, not blocking strategies.
         launched = []
-        for idxs, plan in grouped:
-            ev, (val_of,), B = pad_batch_bucketed(batch["events"][idxs],
+        for gb, (idxs, plan) in zip(group_batches, grouped):
+            ev, (val_of,), B = pad_batch_bucketed(gb["events"],
                                                   (plan.val_of,))
             kern = make_pallas_batch_checker(model, plan.n_slots,
                                              plan.n_states, ev.shape[1],
-                                             interpret=interpret)
+                                             interpret=interpret,
+                                             macro_p=gb.get("macro_p"))
             ok, _ = kern(ev, val_of)
             launched.append((ok, B))
         n_valid = sum(int(np.asarray(ok)[:B].sum()) for ok, B in launched)
@@ -316,11 +388,12 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             # route them through the sort ladder like the dense run does
             # (dropping them would trip the verdict-mismatch guard).
             _, _, nv, nu = check_batch_sharded(
-                model, batch["events"][rest], mesh, n_slots=n_slots)
+                model, rest_events, mesh, n_slots=n_slots)
             n_valid += nv
             n_unknown += nu
         t2 = time.perf_counter()
-        return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown
+        return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
+                {"scan_steps": scan_steps})
 
     def run_chunks():
         """ISSUE-3 chunked wavefront: per-group packing, decided-row
@@ -333,15 +406,17 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
 
         consume_stats()  # this rep's counters only
         t0 = time.perf_counter()
-        triples = [(idxs, plan, pack_batch([encs[i] for i in idxs]))
+        triples = [(idxs, plan, _group_pack([encs[i] for i in idxs]))
                    for idxs, plan in grouped]
         t1 = time.perf_counter()
+        scan_steps = sum(int(b["n_events"].sum()) for _, _, b in triples)
         launches, _ = build_dense_launches(
             model, triples, host_route=_route_group_to_host)
         outs = run_chunked(launches)
         n_valid = sum(int(o.ok.sum()) for o in outs)
         n_unknown = sum(int((~o.ok & o.overflow).sum()) for o in outs)
         if rest:
+            scan_steps += sum(encs[i].n_events for i in rest)
             _, _, nv, nu = check_batch_sharded(
                 model, pack_batch([encs[i] for i in rest])["events"],
                 mesh, n_slots=n_slots)
@@ -349,34 +424,35 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             n_unknown += nu
         t2 = time.perf_counter()
         return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
-                consume_stats())
+                dict(consume_stats(), scan_steps=scan_steps))
 
     def run():
         if want_pallas:
-            return run_pallas() + ({},)
+            return run_pallas()
         if grouped and scan_chunk() > 0:
             return run_chunks()
         t0 = time.perf_counter()
-        batch = pack_batch(encs)
+        group_batches, rest_events, scan_steps = pack_run_inputs()
         t1 = time.perf_counter()
         n_valid = n_unknown = 0
         # Launch every window group, block once: over the TPU tunnel a
         # blocking loop pays a network round trip per group.
         finalizers = [
-            check_batch_sharded(model, batch["events"][idxs], mesh,
-                                dense=plan, defer=True)
-            for idxs, plan in grouped
+            check_batch_sharded(model, gb["events"], mesh, dense=plan,
+                                defer=True, macro_p=gb.get("macro_p"))
+            for gb, (idxs, plan) in zip(group_batches, grouped)
         ]
         if rest:
             finalizers.append(check_batch_sharded(
-                model, batch["events"][rest], mesh, n_slots=n_slots,
+                model, rest_events, mesh, n_slots=n_slots,
                 defer=True))
         for fin in finalizers:
             _, _, nv, nu = fin()
             n_valid += nv
             n_unknown += nu
         t2 = time.perf_counter()
-        return t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown, {}
+        return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
+                {"scan_steps": scan_steps})
 
     run()  # warm-up: compile
     beat()
@@ -428,9 +504,18 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "groups_early_exited": scan_stats.get("groups_early_exited", 0),
         "pipeline_overlap_s": round(
             scan_stats.get("pipeline_overlap_s", 0.0), 3),
+        # Macro-event compaction (ISSUE-4): scan_steps = summed stream
+        # rows the kernels semantically scan (#FORCEs + spill under
+        # macro; every packed event = scan_steps_legacy under the
+        # JGRAFT_MACRO_EVENTS=0 ablation).
+        "macro_events": int(use_macro),
+        "scan_steps": scan_stats.get("scan_steps", legacy_steps),
+        "scan_steps_legacy": legacy_steps,
         # value/time_s are the best rep; the full spread stays in the
         # artifact so the tunnel's variance is never laundered away.
         "rep_times_s": [round(t, 3) for t in rep_times],
+        **cold_warm(rep_times),
+        "host_fingerprint": host_fingerprint(),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
         "platform_note": platform_note,
@@ -454,7 +539,8 @@ def run_suite(platform_note: str) -> None:
     from jepsen_jgroups_raft_tpu.models.register import CasRegister
 
     platform = jax.devices()[0].platform
-    emit({"suite_platform": platform, "note": platform_note})
+    emit({"suite_platform": platform, "note": platform_note,
+          "host_fingerprint": host_fingerprint()})
     # JGRAFT_SUITE_SCALE in (0,1] shrinks every config proportionally —
     # smoke-testing the suite plumbing without the full-size wall clock.
     scale = float(os.environ.get("JGRAFT_SUITE_SCALE", "1"))
@@ -488,6 +574,7 @@ def run_suite(platform_note: str) -> None:
               "histories_per_sec": round(len(hists) / dt, 2),
               "invalid_or_unknown": len(bad), "kernel": kernels,
               "rep_times_s": [round(t, 3) for t in times],
+              **cold_warm(times),
               "evicted_rows": scan["evicted_rows"],
               "chunks_run": scan["chunks_run"],
               "pipeline_overlap_s": round(scan["pipeline_overlap_s"], 3),
@@ -532,6 +619,7 @@ def run_suite(platform_note: str) -> None:
           "histories_per_sec": round(summary["histories"] / dt, 2),
           "invalid_or_unknown": summary["n-invalid"] + summary["n-unknown"],
           "rep_times_s": [round(t, 3) for t in times],
+          **cold_warm(times),
           "platform": platform})
 
     # 4: independent multi-key, 10k ops per history.

@@ -47,7 +47,8 @@ import numpy as np
 
 from ..history.ops import History
 from ..history.packing import (EncodedHistory, bucket_rows, encode_history,
-                               pack_batch, pad_batch_bucketed)
+                               macro_events_on, pack_batch,
+                               pack_macro_batch, pad_batch_bucketed)
 from ..ops.dense_scan import (MASK_DENSE_MAX_SLOTS, MERGE_MAX_EVENTS,
                               dense_plans_grouped, make_dense_batch_checker)
 from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
@@ -275,6 +276,14 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
     # silently measure the segmented XLA kernel).
     want_pallas = (kernel == "pallas" or
                    os.environ.get("JGRAFT_KERNEL") == "pallas")
+    # Macro-event compaction (ISSUE-4 tentpole): every kernel family
+    # consumes the macro stream unless JGRAFT_MACRO_EVENTS=0 pins the
+    # legacy one-event-per-step stream (the differential/ablation path;
+    # verdicts are bitwise-identical either way). Eligibility/grouping
+    # above stays on the legacy encoding — only kernel consumption
+    # switches. The segmented long-history path keeps legacy events
+    # (its cut/basis planner reasons about per-event quiescence).
+    _group_pack = pack_macro_batch if macro_events_on() else pack_batch
     if fits and n_configs is None and n_slots is None and not want_pallas:
         # Long histories first: the segmented scan (ops/segment_scan.py)
         # cuts a 100k+-event stream at quiescent boundaries and runs the
@@ -338,7 +347,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             for idxs, plan in grouped:
                 sub = [fits[j] for j in idxs]
                 triples.append((sub, plan,
-                                pack_batch([encs[i] for i in sub])))
+                                _group_pack([encs[i] for i in sub])))
             launches, subs = build_dense_launches(
                 model, triples, host_route=_route_group_to_host)
             with _maybe_profile():
@@ -368,7 +377,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             with _maybe_profile():
                 for idxs, plan in grouped:
                     sub = [fits[j] for j in idxs]
-                    batch = pack_batch([encs[i] for i in sub])
+                    batch = _group_pack([encs[i] for i in sub])
                     # Bucketing trades padding work for jit-cache
                     # stability. For LONG histories the trade inverts:
                     # bucketing a 12k-event cluster to 16k events adds
@@ -379,7 +388,12 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                     # can exceed 16 rows, so exactness keys on
                     # long-ness alone, not group size).
                     e_len = batch["events"].shape[1]
-                    exact = e_len > MERGE_MAX_EVENTS
+                    # Exactness (and the host gate below) key on the
+                    # LEGACY event length: the policies were calibrated
+                    # on it, and a macro batch's ~2× shorter row count
+                    # must not silently halve their thresholds.
+                    e_legacy = batch.get("legacy_events", e_len)
+                    exact = e_legacy > MERGE_MAX_EVENTS
                     ev, (val_of,), B = pad_batch_bucketed(
                         batch["events"], (plan.val_of,),
                         floor_b=len(sub) if exact else 8,
@@ -395,12 +409,17 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                         kernel = make_pallas_batch_checker(
                             model, plan.n_slots, plan.n_states,
                             ev.shape[1],
-                            interpret=jax.default_backend() != "tpu")
+                            interpret=jax.default_backend() != "tpu",
+                            macro_p=batch.get("macro_p"))
                         tag = "pallas"
                     else:
                         kernel = make_dense_batch_checker(
-                            model, plan.kind, plan.n_slots, plan.n_states)
-                        if _route_group_to_host(ev.shape[0], ev.shape[1]):
+                            model, plan.kind, plan.n_slots, plan.n_states,
+                            macro_p=batch.get("macro_p"))
+                        if _route_group_to_host(
+                                ev.shape[0],
+                                e_legacy if exact
+                                else bucket_rows(e_legacy, 32)):
                             # Tiny batch + tunneled chip: the host mesh
                             # wins (see PLATFORM_ROUTE_MIN_CELLS).
                             # Committed inputs carry the computation to
@@ -450,7 +469,9 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                   else [DEFAULT_N_CONFIGS])
         remaining = fits
         for rung, eff_configs in enumerate(ladder):
-            batch = pack_batch([encs[i] for i in remaining])
+            # The C-ladder consumes the macro stream too: every rung's
+            # kernel (chunked or monolithic) keys on the batch's P.
+            batch = _group_pack([encs[i] for i in remaining])
             t0 = time.perf_counter()
             if scan_chunk() > 0:
                 # Chunked sort scan (ISSUE 3): same rung, but decided
@@ -458,7 +479,8 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                 # when every row is decided. The ladder still blocks
                 # per rung — the escalation decision needs the flags.
                 init_fn, step_fn = make_sort_chunk_checker(
-                    model, eff_configs, eff_slots)
+                    model, eff_configs, eff_slots,
+                    macro_p=batch.get("macro_p"))
                 with _maybe_profile():
                     [out] = run_chunked([ChunkLaunch(
                         events=batch["events"],
@@ -468,7 +490,8 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                         tag="sort")])
                 ok, overflow = out.ok, out.overflow
             else:
-                kernel = make_batch_checker(model, eff_configs, eff_slots)
+                kernel = make_batch_checker(model, eff_configs, eff_slots,
+                                            macro_p=batch.get("macro_p"))
                 # Bucket both compile-shape dims (batch, events) to
                 # powers of two so repeated calls hit the jit cache
                 # instead of recompiling per batch size. Pad rows/events

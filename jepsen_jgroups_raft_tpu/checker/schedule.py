@@ -50,6 +50,7 @@ not have (pinned bitwise by tests/test_chunked_scan.py differentials).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
@@ -84,29 +85,68 @@ def scan_chunk() -> int:
 # ------------------------------------------------------------------ stats
 # Aggregated across every wavefront this process runs (thread-safe: race
 # mode drives the jax pass from a worker thread). bench.py consumes them
-# per rep; checker/perf.py snapshots them into its result metadata.
+# per rep; checker/perf.py reads the innermost `stats_scope` so stored
+# per-run artifacts never accumulate across checker invocations.
 
 _STATS_LOCK = threading.Lock()
 _STATS_ZERO = {"chunks_run": 0, "evicted_rows": 0, "groups_run": 0,
                "groups_early_exited": 0, "pipeline_overlap_s": 0.0}
 _STATS = dict(_STATS_ZERO)
+_SCOPES: List[dict] = []  # guarded by _STATS_LOCK; innermost last
 
 
 def _add_stats(**kw) -> None:
     with _STATS_LOCK:
         for k, v in kw.items():
             _STATS[k] += v
+            for scope in _SCOPES:
+                scope[k] += v
 
 
-def snapshot_stats() -> dict:
-    """Copy of the accumulated chunked-scan counters (non-destructive)."""
+@contextlib.contextmanager
+def stats_scope():
+    """Explicit per-run counter scope (ISSUE-4 satellite): counters
+    accumulated while the scope is active land in the yielded dict too,
+    isolated from everything before it. `core/runner.run_test` wraps
+    each test's checking phase in one, so a process running
+    back-to-back checks (soaks, `bench.py --suite` in one interpreter)
+    stores per-run counters instead of process-lifetime accumulation.
+    Nesting-safe (scopes stack) and thread-safe; the process-wide
+    totals that `consume_stats` serves (the bench's per-rep read) are
+    untouched."""
+    scope = dict(_STATS_ZERO)
     with _STATS_LOCK:
+        _SCOPES.append(scope)
+    try:
+        yield scope
+    finally:
+        with _STATS_LOCK:
+            # Remove by IDENTITY: list.remove compares by equality, and
+            # two scopes with identical counters (e.g. nested, both
+            # still zero) are equal dicts — remove() would pop the
+            # outer one and crash the outer exit.
+            for i, s in enumerate(_SCOPES):
+                if s is scope:
+                    del _SCOPES[i]
+                    break
+
+
+def snapshot_stats(scoped: bool = False) -> dict:
+    """Copy of the accumulated chunked-scan counters (non-destructive).
+    `scoped=True` returns the innermost active `stats_scope`'s counters
+    — this run's work only — falling back to the process totals when no
+    scope is active (direct `check_histories` callers outside a test
+    run)."""
+    with _STATS_LOCK:
+        if scoped and _SCOPES:
+            return dict(_SCOPES[-1])
         return dict(_STATS)
 
 
 def consume_stats() -> dict:
-    """Return and reset the accumulated counters (bench.py reads one
-    timed rep's worth at a time)."""
+    """Return and reset the accumulated process-wide counters (bench.py
+    reads one timed rep's worth at a time). Active scopes are not
+    reset — they already hold only their own span's counters."""
     global _STATS
     with _STATS_LOCK:
         out = dict(_STATS)
@@ -198,7 +238,10 @@ def build_dense_launches(model, groups, host_route=None):
     bench.run_chunks both route through it).
 
     groups: iterable of (rows, plan, batch) — `rows` the caller's row
-    ids, `plan` a DensePlan, `batch` the group's pack_batch dict. The
+    ids, `plan` a DensePlan, `batch` the group's pack_batch OR
+    pack_macro_batch dict (a "macro_p" key routes the group through
+    the macro-event chunk kernels; `n_events` then counts macro rows,
+    which is exactly what the span/exhaustion math must run on). The
     launch order is policy and lives HERE: largest group first, so big
     groups' chunks queue ahead of small ones on every device (callers
     must not pre-sort — the bench and the checker must measure the
@@ -235,16 +278,24 @@ def build_dense_launches(model, groups, host_route=None):
     subs: list = []
     for rows, plan, batch in sorted(groups, key=lambda g: -len(g[0])):
         e_len = batch["events"].shape[1]
-        exact = e_len > MERGE_MAX_EVENTS
+        # Both the LONG-group exact-padding policy and the host/TPU
+        # cell gate were calibrated on LEGACY event counts; a macro
+        # batch's ~2× shorter row count must not silently halve their
+        # thresholds (a merged long cluster losing its depth-bound
+        # exemption would host-route onto the placement measured 2.2×
+        # slower). The scan schedule itself runs on macro rows.
+        e_legacy = batch.get("legacy_events", e_len)
+        exact = e_legacy > MERGE_MAX_EVENTS
         e_sched = e_len if exact else bucket_rows(e_len, 32)
         tag = plan.kernel_tag
         # Gate on the same PADDED shapes the legacy path feeds
         # _route_group_to_host (pad_batch_bucketed's row bucket and
-        # floor_e=32 event bucket — e_sched IS that bucket for
-        # non-LONG groups): an unbucketed e_len would flip routing
-        # for groups near the PLATFORM_ROUTE_MIN_CELLS boundary.
+        # floor_e=32 event bucket): an unbucketed length would flip
+        # routing for groups near the PLATFORM_ROUTE_MIN_CELLS boundary.
         host = bool(host_route
-                    and host_route(bucket_rows(len(rows)), e_sched))
+                    and host_route(bucket_rows(len(rows)),
+                                   e_legacy if exact
+                                   else bucket_rows(e_legacy, 32)))
         if host:
             import jax
 
@@ -254,7 +305,8 @@ def build_dense_launches(model, groups, host_route=None):
             placement = None if exact else sharding
         init_fn, step_fn = make_dense_chunk_checker(
             model, plan.kind, plan.n_slots, plan.n_states,
-            mesh=mesh if placement is sharding else None)
+            mesh=mesh if placement is sharding else None,
+            macro_p=batch.get("macro_p"))
         launches.append(ChunkLaunch(
             events=batch["events"], n_events=batch["n_events"],
             init_fn=init_fn, step_fn=step_fn, val_of=plan.val_of,
@@ -298,7 +350,10 @@ def _init_group(launch: ChunkLaunch, chunk: int) -> _GroupState:
     e_pad = ((e_sched + chunk - 1) // chunk) * chunk
     padded = launch.events
     if e_pad != E:
-        padded = np.zeros((B, e_pad, 5), dtype=launch.events.dtype)
+        # Row width follows the stream format: 5 legacy fields or
+        # 3 + 4·P macro lanes (history/packing.py macro_compact).
+        padded = np.zeros((B, e_pad, launch.events.shape[2]),
+                          dtype=launch.events.dtype)
         padded[:, :E] = launch.events
     padded_b = B if launch.exact_rows else _bucket_launch_rows(launch, B)
     slot_rows = np.full((padded_b,), -1, dtype=np.int32)

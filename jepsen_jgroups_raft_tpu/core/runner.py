@@ -294,8 +294,20 @@ def run_test(test: dict) -> dict:
     test["history"] = history
     checker = test.get("checker")
     if checker is not None:
+        from ..checker.perf import format_scan_stats
+        from ..checker.schedule import stats_scope
+
         LOG.info("checking %d-op history", len(history))
-        test["results"] = checker.check(test, history, {})
+        # Per-run scan-stats scope: this run's chunked-scan counters,
+        # isolated from every other run this process executes. Stamped
+        # AFTER the composed check completes — the perf sub-checker runs
+        # before the workload checker inside the composition, so only
+        # the runner sees the run's full counters.
+        with stats_scope() as scan_scope:
+            test["results"] = checker.check(test, history, {})
+        scan = format_scan_stats(scan_scope)
+        if scan is not None and isinstance(test["results"], dict):
+            test["results"].setdefault("scan-stats", scan)
     else:
         test["results"] = {"valid?": True, "note": "no checker"}
 

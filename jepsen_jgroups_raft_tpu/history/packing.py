@@ -28,6 +28,14 @@ configuration set.
 `fail` completions are dropped before packing (the op never executed), and
 idempotent info ops were dropped by the model encoding — mirroring the
 reference's error taxonomy (workload/client.clj:52-63).
+
+By default the kernels consume this stream MACRO-COMPACTED
+(`macro_compact` / `pack_macro_batch`, ISSUE 4): each run of
+consecutive OPENs coalesces into the FORCE step that ends it, so the
+scan length drops to #FORCEs + spill. Since OPENs only latch registers
+and closure was already deferred to FORCE events, the batched latch is
+verdict-preserving bit for bit (doc/checker-design.md §1b);
+JGRAFT_MACRO_EVENTS=0 restores the one-event-per-step stream.
 """
 
 from __future__ import annotations
@@ -38,6 +46,7 @@ from typing import Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..platform import env_int
 from .ops import (NIL, History, Op, OpPair,  # noqa: F401  (NIL re-exported)
                   pair_ops, pair_ops_indexed)
 
@@ -45,6 +54,14 @@ from .ops import (NIL, History, Op, OpPair,  # noqa: F401  (NIL re-exported)
 EV_PAD = 0
 EV_OPEN = 1
 EV_FORCE = 2
+
+#: Cap on opens carried by one macro-event row. Bounds the row width
+#: (3 + 4·P int32 lanes) independently of the concurrency window — a
+#: timeout-polluted sort-kernel history can hold ~100 slots open at
+#: once, and an uncapped row would grow past 400 lanes for a run the
+#: spill rule handles in ⌈run/16⌉ latch-only rows instead. Dense-kernel
+#: runs (window ≤ 12) never spill at this cap.
+MACRO_MAX_OPENS = 16
 
 
 @dataclass
@@ -407,4 +424,129 @@ def pack_batch(
         "op_index": op_index,
         "n_events": ne,
         "n_slots": ns,
+    }
+
+
+def macro_events_on() -> bool:
+    """Whether kernels consume the macro-compacted event stream (ISSUE-4
+    tentpole; see `macro_compact`). ``JGRAFT_MACRO_EVENTS=0`` restores
+    the legacy one-event-per-step stream — the differential/ablation
+    path the macro≡legacy tests pin verdict-identical. Parsed
+    defensively (`platform.env_int`): garbage warns and keeps the
+    default (on)."""
+    return env_int("JGRAFT_MACRO_EVENTS", 1, minimum=0) != 0
+
+
+def bucket_opens(n: int, cap: int = MACRO_MAX_OPENS) -> int:
+    """Macro payload width P for a group whose longest open run is `n`:
+    the pow2+midpoint series (1, 2, 3, 4, 6, 8, 12, 16 — same shape
+    discipline as rows/events) capped at MACRO_MAX_OPENS, so one
+    compiled kernel serves a bucket of run lengths instead of a fresh
+    XLA compile per batch. Runs longer than the cap spill into
+    latch-only macro rows (`macro_compact`)."""
+    return min(_bucket_pow2(max(int(n), 1), 1), cap)
+
+
+def max_open_run(events: np.ndarray) -> int:
+    """Longest run of consecutive OPEN events (the quantity P buckets):
+    opens are grouped by the number of FORCEs preceding them — the
+    trailing group (crashed never-forced opens) counts too."""
+    et = np.asarray(events)[:, 0]
+    is_open = et == EV_OPEN
+    if not is_open.any():
+        return 0
+    grp = np.cumsum(et == EV_FORCE)[is_open]
+    return int(np.bincount(grp).max())
+
+
+def macro_compact(events: np.ndarray, macro_p: int) -> np.ndarray:
+    """Compact a packed [E, 5] event stream into macro-event rows
+    [E_mac, 3 + 4·P] int32 — the ISSUE-4 tentpole encoding.
+
+    Each run of consecutive OPENs coalesces into the FORCE step that
+    ends it: row = [mtype, force_slot, n_opens, (slot, f, a, b)·P].
+    mtype is EV_FORCE for a macro ending in a FORCE, EV_OPEN for a
+    latch-only macro (spill of a run longer than P, or the trailing
+    run of crashed never-forced opens), EV_PAD for batch padding. The
+    kernels latch all n_opens payloads at once (slots within a run are
+    distinct — a slot is only recycled by a FORCE) and then run the
+    single existing closure+FORCE, so the scan length drops to
+    #FORCEs + spill rows while reaching the identical pre-FORCE
+    register state as the one-event-per-step stream (closure is a
+    reachability fixpoint over those registers — the soundness argument
+    in doc/checker-design.md; macro≡legacy pinned bitwise by
+    tests/test_macro_events.py)."""
+    P = int(macro_p)
+    events = np.asarray(events, dtype=np.int32)
+    et = events[:, 0]
+    open_idx = np.flatnonzero(et == EV_OPEN)
+    force_idx = np.flatnonzero(et == EV_FORCE)
+    nF = len(force_idx)
+    # Open group = number of FORCEs strictly before the open (group i's
+    # opens precede force i; group nF is the trailing never-forced run).
+    grp = np.searchsorted(force_idx, open_idx, side="left")
+    counts = np.bincount(grp, minlength=nF + 1)
+    # Rows per group: ⌈opens/P⌉ latch rows, the last one carrying the
+    # group's FORCE; a force with no fresh opens still needs its row.
+    n_rows = -(-counts // P)
+    n_rows[:nF] = np.maximum(n_rows[:nF], 1)
+    row_base = np.concatenate([[0], np.cumsum(n_rows)])
+    total = int(row_base[-1])
+    rows = np.zeros((total, 3 + 4 * P), dtype=np.int32)
+    if nF:
+        frow = row_base[1:nF + 1] - 1
+        rows[frow, 0] = EV_FORCE
+        rows[frow, 1] = events[force_idx, 1]
+    if len(open_idx):
+        # rank of each open within its group
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        j = np.arange(len(open_idx)) - starts[grp]
+        mrow = row_base[grp] + j // P
+        col = 3 + 4 * (j % P)
+        for k in range(4):
+            rows[mrow, col + k] = events[open_idx, 1 + k]
+        rows[:, 2] = np.bincount(mrow, minlength=total)
+    rows[rows[:, 0] == EV_PAD, 0] = EV_OPEN  # latch-only spill/trailing
+    return rows
+
+
+def pack_macro_batch(
+    encoded: Iterable[EncodedHistory],
+    n_events: Optional[int] = None,
+    cap: int = MACRO_MAX_OPENS,
+) -> dict:
+    """Macro-stream twin of `pack_batch`: compact every history of a
+    batch at one shared payload width P (`bucket_opens` of the batch's
+    longest open run) and pad to a common macro-row count. Returns
+    numpy arrays events [B, E_mac, 3+4·P], n_events [B] (MACRO row
+    counts — the scheduler's exhaustion/span math runs on these),
+    n_slots [B], plus the scalar "macro_p" the kernel builders key on
+    and "legacy_events" (the batch's max one-event-per-step length):
+    routing gates calibrated on legacy event counts — the host/TPU
+    cell gate, the LONG-group exact-padding policy — must keep reading
+    legacy lengths, or the ~2× compaction silently halves their
+    thresholds. Padding rows are EV_PAD no-ops, exactly like
+    `pack_batch`."""
+    encs = list(encoded)
+    if not encs:
+        raise ValueError("empty batch")
+    P = bucket_opens(max(max_open_run(e.events) for e in encs), cap)
+    compacted = [macro_compact(e.events, P) for e in encs]
+    E = n_events or max(max(c.shape[0] for c in compacted), 1)
+    if any(c.shape[0] > E for c in compacted):
+        raise ValueError("n_events smaller than longest macro stream")
+    B = len(encs)
+    events = np.zeros((B, E, 3 + 4 * P), dtype=np.int32)
+    ne = np.zeros((B,), dtype=np.int32)
+    ns = np.zeros((B,), dtype=np.int32)
+    for i, (e, c) in enumerate(zip(encs, compacted)):
+        events[i, : c.shape[0]] = c
+        ne[i] = c.shape[0]
+        ns[i] = e.n_slots
+    return {
+        "events": events,
+        "n_events": ne,
+        "n_slots": ns,
+        "macro_p": P,
+        "legacy_events": max(e.n_events for e in encs),
     }

@@ -38,9 +38,11 @@ Rules
     its contract fails the gate instead of going unchecked.
 
 Scan set (CLI): ``ops/pallas_scan.py``, ``ops/segment_scan.py``,
-``ops/dense_scan.py``, ``parallel/mesh.py`` — the non-Pallas files are
-covered for their declared cap/budget constants and for any
-``pallas_call`` a future PR adds there.
+``ops/dense_scan.py``, ``parallel/mesh.py``, ``history/packing.py`` —
+the non-Pallas files are covered for their declared cap/budget
+constants (incl. the macro-event ``MACRO_MAX_OPENS`` payload cap, whose
+67-lane rows the Pallas tile and chunk-slab bindings sample) and for
+any ``pallas_call`` a future PR adds there.
 """
 
 from __future__ import annotations
@@ -84,9 +86,13 @@ class Contract:
 
 
 def _pallas_scan_tile_budget(interp: Interp) -> List[str]:
-    """tile_histories(S, E) must keep the lane-expanded event block
-    ([5·E, T·S] int32 = T·S·E·20 bytes) inside _EVENTS_VMEM_BUDGET for
-    every legal (S, E) — the exact invariant its docstring claims."""
+    """tile_histories(S, E, R) must keep the lane-expanded event block
+    ([R·E, T·S] int32 = T·S·E·R·4 bytes) inside _EVENTS_VMEM_BUDGET for
+    every legal (S, E, R) — the exact invariant its docstring claims.
+    R samples both stream formats: 5 legacy fields and the widest
+    macro-event row (3 + 4·MACRO_MAX_OPENS = 67 lanes; the macro cap is
+    pinned by history/packing.py's own contract, so widening it fails
+    the gate until these bindings are re-proven)."""
     out = []
     budget = interp.module_env.get("_EVENTS_VMEM_BUDGET")
     fn = interp.functions.get("tile_histories")
@@ -94,15 +100,18 @@ def _pallas_scan_tile_budget(interp: Interp) -> List[str]:
         return ["tile_histories/_EVENTS_VMEM_BUDGET not resolvable"]
     for S in (1, 2, 4, 8, 16):
         for E in (8, 64, 512, 4096, 131072):
-            T = interp.exec_fn(fn, {"n_states": S, "n_events": E})
-            if not isinstance(T, int):
-                out.append(f"tile_histories({S}, {E}) not evaluable")
-                continue
-            if T * S * E * 20 > budget and T > 1:
-                out.append(
-                    f"tile_histories({S}, {E}) = {T}: event block "
-                    f"{T * S * E * 20} B exceeds _EVENTS_VMEM_BUDGET "
-                    f"{budget} B")
+            for R in (5, 35, 67):
+                T = interp.exec_fn(fn, {"n_states": S, "n_events": E,
+                                        "row_ints": R})
+                if not isinstance(T, int):
+                    out.append(
+                        f"tile_histories({S}, {E}, {R}) not evaluable")
+                    continue
+                if T * S * E * R * 4 > budget and T > 1:
+                    out.append(
+                        f"tile_histories({S}, {E}, {R}) = {T}: event "
+                        f"block {T * S * E * R * 4} B exceeds "
+                        f"_EVENTS_VMEM_BUDGET {budget} B")
     return out
 
 
@@ -129,6 +138,25 @@ def _dense_chunk_budget(interp: Interp) -> List[str]:
         elif n > 16 << 20:
             out.append(f"chunked dense carry at (W={W}, S={S}) = {n} B "
                        "exceeds usable per-core VMEM")
+    # Macro-event rows (ISSUE-4): the widened chunk event slab must
+    # still fit next to the carry at the caps. MACRO_MAX_OPENS comes
+    # from history/packing.py via the sibling-constant merge; a cap
+    # bump that outgrows the proven bindings surfaces here, loudly.
+    fn_r = interp.functions.get("macro_row_ints")
+    cap_p = interp.module_env.get("MACRO_MAX_OPENS")
+    if fn_r is None or not isinstance(cap_p, int):
+        out.append(("kernel-unresolved",
+                    "macro_row_ints / MACRO_MAX_OPENS not resolvable"))
+        return out
+    r = interp.exec_fn(fn_r, {"macro_p": cap_p})
+    carry = interp.exec_fn(fn, {"n_slots": caps_w, "n_states": caps_s})
+    if not (isinstance(r, int) and isinstance(carry, int)):
+        out.append(("kernel-unresolved",
+                    f"macro_row_ints({cap_p}) not evaluable"))
+    elif carry + 4096 * r * 4 > 16 << 20:
+        out.append(f"chunked dense carry + macro event slab at the caps "
+                   f"= {carry + 4096 * r * 4} B exceeds usable per-core "
+                   "VMEM")
     return out
 
 
@@ -156,18 +184,43 @@ def _sort_chunk_budget(interp: Interp) -> List[str]:
 CONTRACTS: Dict[str, Contract] = {
     "ops/pallas_scan.py": Contract(
         symbols={"W": (5,), "S": (1, 4, 16), "E": (8, 64, 512),
-                 "T": (1, 4, 32), "G": (1, 2, 8), "interpret": (False,)},
+                 "T": (1, 4, 32), "G": (1, 2, 8),
+                 "R": (5, 35, 67), "interpret": (False,)},
         # the legal envelope tile_histories/make_pallas_batch_checker
         # guarantee: lane axis filled but never overfilled, E padded to
-        # a multiple of 8 (Mosaic sublane rule).
-        where=lambda b: b["T"] * b["S"] <= 128 and b["E"] % 8 == 0,
+        # a multiple of 8 (Mosaic sublane rule — R is odd in both
+        # stream formats, so E itself carries the rule), and for T > 1
+        # the tile budget caps the lane-expanded event block at
+        # _EVENTS_VMEM_BUDGET (T = 1 is the irreducible minimum tile).
+        where=lambda b: (b["T"] * b["S"] <= 128 and b["E"] % 8 == 0
+                         and (b["T"] == 1 or
+                              b["T"] * b["S"] * b["E"] * b["R"] * 4
+                              <= 6 << 20)),
         const_asserts=[
-            ("_EVENTS_VMEM_BUDGET", 16 << 20,
-             "events VMEM budget exceeds usable per-core VMEM"),
+            # Pinned EXACTLY at the value the where-clause envelope
+            # above samples (not just ≤ VMEM): raising the budget
+            # would legalize bigger tiles that the envelope would then
+            # silently stop sampling — fail here until both move
+            # together.
+            ("_EVENTS_VMEM_BUDGET", 6 << 20,
+             "events VMEM budget outgrew the contract's sampled "
+             "envelope (the where-clause bound); move both together"),
             ("_LANE_TARGET", 128, "lane target beyond the 128-lane VPU"),
         ],
         custom=_pallas_scan_tile_budget,
     ),
+    "history/packing.py": Contract(const_asserts=[
+        # The macro payload cap is load-bearing for every kernel
+        # family's proven bindings: the Pallas tile budget and the
+        # chunk-slab checks sample rows at 3 + 4·16 = 67 lanes, so a
+        # cap bump must fail here until those bindings are re-proven.
+        ("MACRO_MAX_OPENS", 16,
+         "macro open cap outgrew the proven kernel-contract bindings "
+         "(R = 67-lane rows); re-prove the Pallas tile and chunk-slab "
+         "budgets before raising it"),
+        ("3 + 4 * MACRO_MAX_OPENS", 67,
+         "macro row width beyond the proven R samples"),
+    ]),
     "ops/dense_scan.py": Contract(const_asserts=[
         ("(1 << DENSE_MAX_SLOTS) * DENSE_MAX_STATES * 4", 16 << 20,
          "dense frontier at the eligibility caps exceeds VMEM"),
@@ -249,14 +302,19 @@ def _enclosing_chain(tree: ast.Module) -> List[Tuple[ast.Call, list]]:
 
 def _merge_sibling_consts(interp: Interp, tree: ast.Module,
                           path: str) -> None:
-    """Resolve `from .sibling import NAME` constants so cross-module cap
-    expressions (segment_scan uses dense_scan's caps) stay checkable."""
+    """Resolve relative-import constants (`from .sibling import NAME`,
+    `from ..pkg.mod import NAME`) so cross-module cap expressions stay
+    checkable — segment_scan uses dense_scan's caps, and dense_scan's
+    macro-row bindings use history/packing.py's MACRO_MAX_OPENS."""
     base = Path(path).parent
     for stmt in tree.body:
         if not (isinstance(stmt, ast.ImportFrom) and stmt.level >= 1
                 and stmt.module):
             continue
-        sib = base / (stmt.module.split(".")[-1] + ".py")
+        target = base
+        for _ in range(stmt.level - 1):
+            target = target.parent
+        sib = target.joinpath(*stmt.module.split(".")).with_suffix(".py")
         if not sib.exists():
             continue
         try:

@@ -29,10 +29,12 @@ Mechanics per event (same event stream as linear_scan — packing.py):
            transition matrix T_w[s, s'] = legal(s) & (step(s) == s') into
            the bit-w=1 half — a butterfly reshape exposing bit w as its
            own axis plus an [?, S] @ [S, S] matmul.
-  FORCE w: survivors must hold bit w (mask with the static bit column),
-           then the bit is recycled by moving the bit-w=1 half onto the
-           bit-w=0 half (the same butterfly, in reverse). The dynamic
-           slot id selects among W static branches via `lax.switch`.
+  FORCE w: survivors must hold bit w (mask with the bit column derived
+           arithmetically from the dynamic slot id), then the bit is
+           recycled by moving the bit-w=1 half onto the bit-w=0 half —
+           one `dynamic_slice` down-shift (`_force_arith`; switch-free,
+           ISSUE 4 — the old `lax.switch` evaluated all W branches
+           under vmap).
 
 The domain table `val_of[S]` is a per-history *input* (id 0 = initial
 state), so one compiled kernel serves a whole batch of histories with
@@ -51,12 +53,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
+from ..history.packing import (EV_FORCE, EV_OPEN, MACRO_MAX_OPENS,
+                               EncodedHistory)
 
-#: Eligibility caps. Per-event work is ~W · 2^W · S² (closure sweeps) and
-#: W · 2^W · S (the vmapped switch evaluates every branch), so the dense
-#: path is reserved for genuinely small problems — which the reference's
-#: own workload shapes are (window ≈ n_procs, domain ≈ 5 values; a few
+#: Eligibility caps. Per-event work is ~W · 2^W · S² (closure sweeps)
+#: plus 2^W · S (the arithmetic FORCE path), so the dense path is
+#: reserved for genuinely small problems — which the reference's own
+#: workload shapes are (window ≈ n_procs, domain ≈ 5 values; a few
 #: crashed ops' never-retiring slots push long histories to W ≈ 10).
 DENSE_MAX_SLOTS = 10
 DENSE_MAX_STATES = 16
@@ -395,30 +398,67 @@ def _closure_fixpoint(W: int, sweep, F, active):
     return F
 
 
-def _make_force_branches(bit_table: np.ndarray, W: int, S: int):
-    """One lax.switch branch per slot for an [M, S] frontier: kill
-    configurations missing bit w (the FORCEd op must have linearized),
-    then recycle the bit by moving the bit-w=1 half of the butterfly onto
-    the bit-w=0 half. Under vmap the switch lowers to select-over-all-
-    branches; each branch is a few [M, S] elementwise ops, so that stays
-    cheap."""
-    M = bit_table.shape[0]
+def _force_arith(F, slot_w):
+    """Switch-free FORCE dispatch (the ISSUE-4 "dense slot dispatch"
+    half): kill configurations missing the forced slot's bit, then
+    recycle the bit by moving the bit=1 half of the butterfly onto the
+    bit=0 half — both computed *arithmetically* from the dynamic slot id
+    (the same style as ops/linear_scan.py's bitvec math) instead of the
+    old `lax.switch` over W static branches, which under vmap lowered to
+    select-over-all-branches: every scan step paid W× the one taken
+    branch's [M, S] work. The down-shift by the dynamic bit weight is
+    one `lax.dynamic_slice` of a zero-extended copy — static shapes, no
+    reshape, no scatter; under vmap the batched start lowers to per-row
+    slices (re-ablate on chip if that regresses — both the macro and
+    the JGRAFT_MACRO_EVENTS=0 legacy stream share this dispatch, so the
+    macro A/B stays a pure stream-length comparison).
 
-    def _mk(w):
-        has = jnp.asarray(bit_table[:, w], bool)
+    F: [M, S] bool (mask mode passes S=1); slot_w pre-clipped to
+    [0, W). Returns (F', any_survivor)."""
+    M, S = F.shape
+    ids = jnp.arange(M, dtype=jnp.int32)
+    has = ((ids >> slot_w) & 1) == 1            # [M] bit slot_w of m
+    Fk = F & has[:, None]
+    alive = jnp.any(Fk)
+    ext = jnp.concatenate([Fk, jnp.zeros_like(Fk)], axis=0)  # [2M, S]
+    shifted = lax.dynamic_slice(
+        ext, (jnp.int32(1) << slot_w, jnp.int32(0)), (M, S))
+    return jnp.where(has[:, None], False, shifted), alive
 
-        def branch(F):
-            Fk = F & has[:, None]
-            alive = jnp.any(Fk)
-            Fb = Fk.reshape(M >> (w + 1), 2, 1 << w, S)
-            moved = jnp.concatenate(
-                [Fb[:, 1:2], jnp.zeros_like(Fb[:, 1:2])], axis=1
-            ).reshape(M, S)
-            return moved, alive
 
-        return branch
+def _macro_cols(row, macro_p: int):
+    """Split one macro-event row [3 + 4·P] (history/packing.py
+    macro_compact layout) into (mtype, force_slot, n_opens,
+    pslot [P], pf [P], pa [P], pb [P])."""
+    pay = row[3:3 + 4 * macro_p].reshape(macro_p, 4)
+    return (row[0], row[1], row[2],
+            pay[:, 0], pay[:, 1], pay[:, 2], pay[:, 3])
 
-    return [_mk(w) for w in range(W)]
+
+def _macro_select(slot_ids, pslot, valid):
+    """Masked-scatter helpers for the vectorized multi-slot latch:
+    eq [W, P] marks which payload lands in which slot register (slots
+    within a macro are distinct — packing only recycles a slot at its
+    FORCE — so at most one payload matches per slot), upd [W] which
+    slots update at all."""
+    eq = (slot_ids[:, None] == pslot[None, :]) & valid[None, :]
+    return eq, eq.any(axis=1)
+
+
+def _macro_latch_i32(eq, upd, old, new):
+    """old [W] int32 register ← payload values new [P] where upd."""
+    return jnp.where(upd, (eq.astype(jnp.int32) * new[None, :]).sum(1),
+                     old)
+
+
+def macro_row_ints(macro_p: int = MACRO_MAX_OPENS) -> int:
+    """int32 lanes of one macro-event row: [mtype, force_slot, n_opens]
+    + macro_p × (slot, f, a, b); defaults to the widest row the encoder
+    can emit (the MACRO_MAX_OPENS cap). Pure arithmetic on purpose —
+    the kernel-contract analyzer (lint/flow/kernel_contract.py)
+    executes it statically at the cap to re-prove the chunk event slabs
+    and the Pallas lane-expanded block against the VMEM budgets."""
+    return 3 + 4 * macro_p
 
 
 def hoist_transitions() -> bool:
@@ -453,7 +493,8 @@ def hoist_transitions() -> bool:
 
 
 def dense_step_parts(model, n_slots: int, n_states: int,
-                     hoist: Optional[bool] = None):
+                     hoist: Optional[bool] = None,
+                     macro_p: Optional[int] = None):
     """The domain kernel decomposed for chunked execution: returns
     (init, scan_step, verdict) where `init(val_of) -> carry`,
     `scan_step` is the per-event body, and `verdict(carry) ->
@@ -462,9 +503,19 @@ def dense_step_parts(model, n_slots: int, n_states: int,
     body, two drivers, so the chunked wavefront (checker/schedule.py)
     can never diverge semantically from the reference scan.
 
+    `macro_p`: when set, `scan_step` consumes MACRO-event rows of
+    3 + 4·macro_p lanes (history/packing.py macro_compact) — up to
+    macro_p opens latched in one vectorized masked scatter, then the
+    identical closure+FORCE the one-event-per-step stream runs. The
+    batched latch reaches the same pre-FORCE register state the legacy
+    stream reaches one event at a time, and closure is a reachability
+    fixpoint over exactly those registers, so verdicts are bitwise
+    identical (pinned by tests/test_macro_events.py); None keeps the
+    legacy [E, 5] row format (the JGRAFT_MACRO_EVENTS=0 ablation).
+
     Step shape note (round-5): a gather-based rewrite of this kernel
     (Jacobi closure over one [W,M,S] gather + einsum, gather-based
-    FORCE) measured ~2× SLOWER on v5e than this butterfly/switch form
+    FORCE) measured ~2× SLOWER on v5e than this butterfly form
     (config-4 5.2 s vs 2.4 s, counter suite 12.3 s vs 7.0 s, same
     session) — TPU gathers at these tiny shapes cost more than the
     fusion count they save, which is exactly why the design invariant
@@ -476,8 +527,6 @@ def dense_step_parts(model, n_slots: int, n_states: int,
     W, S = int(n_slots), int(n_states)
     M = 1 << W
     slot_ids = jnp.arange(W, dtype=jnp.int32)
-    bit_table = _bit_table(M, W)
-    force_branches = _make_force_branches(bit_table, W, S)
 
     def expand_w(w, F, T_w):
         """One slot's flow: configs without bit w linearize op w
@@ -503,6 +552,21 @@ def dense_step_parts(model, n_slots: int, n_states: int,
             row = (ns[:, None] == val_of[None, :]) & legal[:, None]
             return (jnp.where(upd[:, None, None], row[None], T),)
 
+        def style_macro_latch(extra, eq, upd, pf, pa, pb, val_of):
+            # Per-payload transition rows, selected into the slot axis
+            # by the (at-most-one-match) eq matrix — the batched twin
+            # of style_update's single-row write.
+            (T,) = extra
+            ns, legal = jax.vmap(
+                lambda f_, a_, b_: model.jax_step(val_of, f_, a_, b_)
+            )(pf, pa, pb)                                 # [P, S] each
+            rows = ((ns[:, :, None] == val_of[None, None, :]) &
+                    legal[:, :, None])                    # [P, S, S']
+            Tnew = jnp.tensordot(eq.astype(jnp.float32),
+                                 rows.astype(jnp.float32),
+                                 axes=([1], [0])) > 0     # [W, S, S']
+            return (jnp.where(upd[:, None, None], Tnew, T),)
+
         def style_sweep(extra, slot_open, val_of):
             (T,) = extra
             Te = (T & slot_open[:, None, None]).astype(jnp.float32)
@@ -522,6 +586,12 @@ def dense_step_parts(model, n_slots: int, n_states: int,
             return (jnp.where(upd, f, sf), jnp.where(upd, a, sa),
                     jnp.where(upd, b, sb))
 
+        def style_macro_latch(extra, eq, upd, pf, pa, pb, val_of):
+            sf, sa, sb = extra
+            return (_macro_latch_i32(eq, upd, sf, pf),
+                    _macro_latch_i32(eq, upd, sa, pa),
+                    _macro_latch_i32(eq, upd, sb, pb))
+
         def style_sweep(extra, slot_open, val_of):
             sf, sa, sb = extra
 
@@ -537,32 +607,60 @@ def dense_step_parts(model, n_slots: int, n_states: int,
 
             return sweep
 
-    def scan_step(carry, ev):
-        F, extra, slot_open, ok, dirty, val_of = carry
-        etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
-        is_open = etype == EV_OPEN
-        is_force = etype == EV_FORCE
-
-        onehot = slot_ids == slot
-        upd = onehot & is_open
-        extra = style_update(extra, upd, f, a, b, val_of)
-        slot_open = jnp.where(upd, True, slot_open)
-        dirty = dirty | is_open
-
-        # Closure only when an OPEN happened since the last one: a closed
-        # frontier stays closed under FORCE kill+clear (extensions of a
-        # surviving config are supersets, so they survived and cleared
-        # too), so back-to-back completions skip the sweeps entirely.
+    def _force_phase(F, extra, slot_open, ok, dirty, val_of, is_force,
+                     slot):
+        """Shared closure+FORCE tail: identical for the legacy and
+        macro streams (the whole soundness argument — the latch phases
+        reach the same registers, then run THIS same code)."""
         F = _closure_fixpoint(W, style_sweep(extra, slot_open, val_of),
                               F, is_force & dirty)
         dirty = dirty & ~is_force
-
-        slot_w = jnp.clip(slot, 0, W - 1)
-        F_forced, alive = lax.switch(slot_w, force_branches, F)
+        F_forced, alive = _force_arith(F, jnp.clip(slot, 0, W - 1))
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
-        slot_open = slot_open & ~(onehot & is_force)
-        return (F, extra, slot_open, ok, dirty, val_of), None
+        slot_open = slot_open & ~((slot_ids == slot) & is_force)
+        return F, slot_open, ok, dirty
+
+    if macro_p is None:
+        def scan_step(carry, ev):
+            F, extra, slot_open, ok, dirty, val_of = carry
+            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+            is_open = etype == EV_OPEN
+            is_force = etype == EV_FORCE
+
+            onehot = slot_ids == slot
+            upd = onehot & is_open
+            extra = style_update(extra, upd, f, a, b, val_of)
+            slot_open = jnp.where(upd, True, slot_open)
+            dirty = dirty | is_open
+
+            # Closure only when an OPEN happened since the last one: a
+            # closed frontier stays closed under FORCE kill+clear
+            # (extensions of a surviving config are supersets, so they
+            # survived and cleared too), so back-to-back completions
+            # skip the sweeps entirely.
+            F, slot_open, ok, dirty = _force_phase(
+                F, extra, slot_open, ok, dirty, val_of, is_force, slot)
+            return (F, extra, slot_open, ok, dirty, val_of), None
+    else:
+        P = int(macro_p)
+
+        def scan_step(carry, row):
+            F, extra, slot_open, ok, dirty, val_of = carry
+            mtype, fslot, n, pslot, pf, pa, pb = _macro_cols(row, P)
+            is_force = mtype == EV_FORCE
+
+            # Vectorized multi-slot latch: ≤P opens masked-scattered
+            # into the slot registers in one step.
+            eq, upd = _macro_select(slot_ids, pslot,
+                                    jnp.arange(P, dtype=jnp.int32) < n)
+            extra = style_macro_latch(extra, eq, upd, pf, pa, pb, val_of)
+            slot_open = slot_open | upd
+            dirty = dirty | (n > 0)
+
+            F, slot_open, ok, dirty = _force_phase(
+                F, extra, slot_open, ok, dirty, val_of, is_force, fslot)
+            return (F, extra, slot_open, ok, dirty, val_of), None
 
     def init(val_of):
         F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
@@ -581,11 +679,13 @@ def dense_step_parts(model, n_slots: int, n_states: int,
 
 
 def make_dense_history_checker(model, n_slots: int, n_states: int,
-                               hoist: Optional[bool] = None):
-    """Build fn(events [E,5], val_of [S]) -> (valid, overflow=False).
-    See `dense_step_parts` for the kernel mechanics."""
+                               hoist: Optional[bool] = None,
+                               macro_p: Optional[int] = None):
+    """Build fn(events [E,5], val_of [S]) -> (valid, overflow=False)
+    (macro_p: [E_mac, 3+4·P] macro rows instead). See
+    `dense_step_parts` for the kernel mechanics."""
     init, scan_step, verdict = dense_step_parts(model, n_slots, n_states,
-                                                hoist)
+                                                hoist, macro_p)
 
     def check(events, val_of):
         carry, _ = lax.scan(scan_step, init(val_of), events,
@@ -595,11 +695,13 @@ def make_dense_history_checker(model, n_slots: int, n_states: int,
     return check
 
 
-def mask_step_parts(model, n_slots: int):
+def mask_step_parts(model, n_slots: int, macro_p: Optional[int] = None):
     """Mask-mode kernel decomposed for chunked execution — same
-    (init, scan_step, verdict) contract as `dense_step_parts`; the
-    calling-convention dummy `val_of` is accepted (and ignored) by
-    `init` so both dense kinds share one chunk-driver signature.
+    (init, scan_step, verdict) contract as `dense_step_parts` (incl.
+    the `macro_p` macro-event stream mode and its bitwise-identity
+    argument); the calling-convention dummy `val_of` is accepted (and
+    ignored) by `init` so both dense kinds share one chunk-driver
+    signature.
 
     Mask-mode kernel for order-independent models (counter): the
     frontier is a bare bitset F[2^W] — config m's state is
@@ -615,9 +717,7 @@ def mask_step_parts(model, n_slots: int):
     W = int(n_slots)
     M = 1 << W
     slot_ids = jnp.arange(W, dtype=jnp.int32)
-    bit_table = _bit_table(M, W)
-    bit_i32 = jnp.asarray(bit_table, jnp.int32)   # [M, W]
-    force_branches = _make_force_branches(bit_table, W, 1)
+    bit_i32 = jnp.asarray(_bit_table(M, W), jnp.int32)   # [M, W]
 
     def expand_w(w, F, legal_all):
         Fb = F.reshape(M >> (w + 1), 2, 1 << w, 1)
@@ -626,28 +726,11 @@ def mask_step_parts(model, n_slots: int):
         return jnp.concatenate([Fb[:, :1], grown[:, None]],
                                axis=1).reshape(M, 1)
 
-    def scan_step(carry, ev):
-        (F, base, sums, slot_delta, slot_f, slot_a, slot_b, slot_open, ok,
-         dirty) = carry
-        etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
-        is_open = etype == EV_OPEN
-        is_force = etype == EV_FORCE
-
-        onehot = slot_ids == slot
-        upd = onehot & is_open
-        slot_f = jnp.where(upd, f, slot_f)
-        slot_a = jnp.where(upd, a, slot_a)
-        slot_b = jnp.where(upd, b, slot_b)
-        slot_open = jnp.where(upd, True, slot_open)
-        dirty = dirty | is_open
-        # Maintain sums[m] = Σ_w bit_w(m) · slot_delta[w] as slot w's
-        # delta changes from its stale value to this op's.
-        col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)  # [M]
-        old_d = jnp.sum(jnp.where(onehot, slot_delta, 0))
-        new_d = model.mask_delta(f, a, b)
-        sums = jnp.where(is_open, sums + col * (new_d - old_d), sums)
-        slot_delta = jnp.where(upd, new_d, slot_delta)
-
+    def _force_phase(carry_tail, is_force, slot):
+        """Shared closure+FORCE tail (identical for legacy and macro
+        streams; see dense_step_parts)."""
+        (F, base, sums, slot_delta, slot_f, slot_a, slot_b, slot_open,
+         ok, dirty) = carry_tail
         # Per-slot legality over ALL M config states at once: state and
         # slot registers are closure-invariant, so this lifts the
         # model.jax_step calls out of the fixpoint loop entirely (the
@@ -667,18 +750,81 @@ def mask_step_parts(model, n_slots: int):
         F = _closure_fixpoint(W, sweep, F, is_force & dirty)
         dirty = dirty & ~is_force
 
-        F_forced, alive = lax.switch(jnp.clip(slot, 0, W - 1),
-                                     force_branches, F)
+        F_forced, alive = _force_arith(F, jnp.clip(slot, 0, W - 1))
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
-        # Retire the forced op: its delta is now part of every survivor's
-        # permanent prefix (base), and its slot leaves the open set.
+        # Retire the forced op: its delta is now part of every
+        # survivor's permanent prefix (base), and its slot leaves the
+        # open set.
+        onehot = slot_ids == slot
+        col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)  # [M]
+        old_d = jnp.sum(jnp.where(onehot, slot_delta, 0))
         base = base + jnp.where(is_force, old_d, 0)
         sums = jnp.where(is_force, sums - col * old_d, sums)
         slot_delta = jnp.where(onehot & is_force, 0, slot_delta)
         slot_open = slot_open & ~(onehot & is_force)
         return (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
-                slot_open, ok, dirty), None
+                slot_open, ok, dirty)
+
+    if macro_p is None:
+        def scan_step(carry, ev):
+            (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+             slot_open, ok, dirty) = carry
+            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+            is_open = etype == EV_OPEN
+            is_force = etype == EV_FORCE
+
+            onehot = slot_ids == slot
+            upd = onehot & is_open
+            slot_f = jnp.where(upd, f, slot_f)
+            slot_a = jnp.where(upd, a, slot_a)
+            slot_b = jnp.where(upd, b, slot_b)
+            slot_open = jnp.where(upd, True, slot_open)
+            dirty = dirty | is_open
+            # Maintain sums[m] = Σ_w bit_w(m) · slot_delta[w] as slot
+            # w's delta changes from its stale value to this op's.
+            col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)
+            old_d = jnp.sum(jnp.where(onehot, slot_delta, 0))
+            new_d = model.mask_delta(f, a, b)
+            sums = jnp.where(is_open, sums + col * (new_d - old_d), sums)
+            slot_delta = jnp.where(upd, new_d, slot_delta)
+
+            carry = _force_phase(
+                (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+                 slot_open, ok, dirty), is_force, slot)
+            return carry, None
+    else:
+        P = int(macro_p)
+
+        def scan_step(carry, row):
+            (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+             slot_open, ok, dirty) = carry
+            mtype, fslot, n, pslot, pf, pa, pb = _macro_cols(row, P)
+            is_force = mtype == EV_FORCE
+
+            valid = jnp.arange(P, dtype=jnp.int32) < n
+            eq, upd = _macro_select(slot_ids, pslot, valid)
+            sel = eq.astype(jnp.int32)
+            # Pre-latch deltas of the opened slots (0 in practice — a
+            # recycled slot's delta was zeroed at its FORCE — but the
+            # legacy stream computes the general form, so mirror it).
+            old_d = (sel * slot_delta[:, None]).sum(0)           # [P]
+            new_d = jax.vmap(model.mask_delta)(pf, pa, pb)       # [P]
+            slot_f = _macro_latch_i32(eq, upd, slot_f, pf)
+            slot_a = _macro_latch_i32(eq, upd, slot_a, pa)
+            slot_b = _macro_latch_i32(eq, upd, slot_b, pb)
+            slot_open = slot_open | upd
+            dirty = dirty | (n > 0)
+            cols = jnp.take(bit_i32, jnp.clip(pslot, 0, W - 1),
+                            axis=1)                              # [M, P]
+            sums = sums + (cols * jnp.where(valid, new_d - old_d,
+                                            0)[None, :]).sum(axis=1)
+            slot_delta = _macro_latch_i32(eq, upd, slot_delta, new_d)
+
+            carry = _force_phase(
+                (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+                 slot_open, ok, dirty), is_force, fslot)
+            return carry, None
 
     def init(val_of):
         del val_of  # calling-convention dummy (see docstring)
@@ -697,10 +843,11 @@ def mask_step_parts(model, n_slots: int):
     return init, scan_step, verdict
 
 
-def make_mask_dense_history_checker(model, n_slots: int):
+def make_mask_dense_history_checker(model, n_slots: int,
+                                    macro_p: Optional[int] = None):
     """fn(events [E,5], val_of [1] ignored) -> (valid, False); see
     `mask_step_parts` for the kernel mechanics."""
-    init, scan_step, verdict = mask_step_parts(model, n_slots)
+    init, scan_step, verdict = mask_step_parts(model, n_slots, macro_p)
 
     def check(events, val_of):
         carry, _ = lax.scan(scan_step, init(val_of), events,
@@ -711,28 +858,35 @@ def make_mask_dense_history_checker(model, n_slots: int):
 
 
 def make_dense_single_checker(model, kind: str, n_slots: int,
-                              n_states: int):
-    """Unified single-history factory: fn(events [E,5], val_of [S])."""
+                              n_states: int,
+                              macro_p: Optional[int] = None):
+    """Unified single-history factory: fn(events [E,5], val_of [S])
+    (macro_p: macro rows of 3+4·P lanes instead of [E,5])."""
     if kind == "mask":
-        return make_mask_dense_history_checker(model, n_slots)
-    return make_dense_history_checker(model, n_slots, n_states)
+        return make_mask_dense_history_checker(model, n_slots, macro_p)
+    return make_dense_history_checker(model, n_slots, n_states,
+                                      macro_p=macro_p)
 
 
 _KERNEL_CACHE: dict = {}
 
 
 def make_dense_batch_checker(model, kind: str, n_slots: int, n_states: int,
-                             jit: bool = True):
-    """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B])."""
+                             jit: bool = True,
+                             macro_p: Optional[int] = None):
+    """vmapped: fn(events [B,E,5], val_of [B,S]) -> (valid[B], overflow[B]).
+    `macro_p` selects the macro-event row format (and keys the cache —
+    a P bucket is a distinct compiled shape, like rows/events)."""
     # scan_unroll() and hoist_transitions() key the cache: the build
     # closures resolve them at trace time, so an env/backend change
     # mid-process (ablation sweeps, CPU degrade after pin_cpu) must map
     # to a distinct compiled kernel.
     key = (*model.cache_key(), kind, int(n_slots), int(n_states), jit,
-           scan_unroll(), hoist_transitions())
+           scan_unroll(), hoist_transitions(), macro_p)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        single = make_dense_single_checker(model, kind, n_slots, n_states)
+        single = make_dense_single_checker(model, kind, n_slots, n_states,
+                                           macro_p)
         fn = jax.vmap(single)
         if jit:
             fn = jax.jit(fn)
@@ -754,9 +908,14 @@ def dense_chunk_carry_bytes(n_slots: int, n_states: int) -> int:
 
 
 def make_dense_chunk_checker(model, kind: str, n_slots: int, n_states: int,
-                             jit: bool = True, mesh=None):
+                             jit: bool = True, mesh=None,
+                             macro_p: Optional[int] = None):
     """Chunked twin of `make_dense_batch_checker` for the wavefront
-    scheduler (checker/schedule.py). Returns (init_fn, step_fn):
+    scheduler (checker/schedule.py). `macro_p` selects the macro-event
+    stream (events are then [B, chunk, 3+4·P] macro rows and `n_events`
+    counts MACRO rows — the scheduler's exhaustion/span math already
+    runs on whatever counts the launch carries). Returns
+    (init_fn, step_fn):
 
       init_fn(val_of [B,S], n_events [B] int32) -> carry (pytree,
           batch-leading: the per-row scan carry + an `events_left` lane)
@@ -789,11 +948,13 @@ def make_dense_chunk_checker(model, kind: str, n_slots: int, n_states: int,
     shape must be explicit, not inferred. Callers pad the batch to a
     multiple of the mesh size (schedule._bucket_launch_rows)."""
     key = ("chunk", *model.cache_key(), kind, int(n_slots), int(n_states),
-           jit, scan_unroll(), hoist_transitions(), mesh)
+           jit, scan_unroll(), hoist_transitions(), mesh, macro_p)
     fns = _KERNEL_CACHE.get(key)
     if fns is None:
-        parts = (mask_step_parts(model, n_slots) if kind == "mask"
-                 else dense_step_parts(model, n_slots, n_states))
+        parts = (mask_step_parts(model, n_slots, macro_p)
+                 if kind == "mask"
+                 else dense_step_parts(model, n_slots, n_states,
+                                       macro_p=macro_p))
         init, scan_step, verdict = parts
 
         def init_one(val_of, n_ev):

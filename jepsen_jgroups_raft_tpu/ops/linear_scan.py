@@ -57,13 +57,16 @@ identical configuration set — see history/packing.py.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
 from ..history.packing import EV_FORCE, EV_OPEN
-from .dense_scan import scan_unroll
+from .dense_scan import (_macro_cols, _macro_latch_i32, _macro_select,
+                         scan_unroll)
 
 #: Hard window cap (4 mask words). Histories needing more concurrent slots
 #: (incl. never-retiring info ops) fall back to the CPU checker, whose
@@ -131,7 +134,8 @@ def _dedup_compact(masks, states, tags, n_configs):
 
 
 def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
-                    n_slots: int = MAX_SLOTS):
+                    n_slots: int = MAX_SLOTS,
+                    macro_p: Optional[int] = None):
     """The sort kernel decomposed for chunked execution: returns
     (init, scan_step, verdict) with `init() -> carry`, the per-event
     `scan_step`, and `verdict(carry) -> (valid, overflow)`. The
@@ -139,7 +143,12 @@ def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
     both drive this one step body, so they cannot diverge semantically.
 
     `model` supplies the vectorized `jax_step` and initial state; `n_configs`
-    (C) and `n_slots` (W ≤ MAX_SLOTS) fix the kernel shape.
+    (C) and `n_slots` (W ≤ MAX_SLOTS) fix the kernel shape. `macro_p`
+    switches `scan_step` to macro-event rows of 3 + 4·P lanes
+    (history/packing.py macro_compact): a vectorized multi-slot latch
+    of ≤P opens, then the identical closure+FORCE — same bitwise-
+    identity argument as ops/dense_scan.dense_step_parts (the FORCE
+    path here was always the arithmetic bitvec form).
     """
     if n_slots > MAX_SLOTS:
         raise ValueError(f"n_slots {n_slots} > {MAX_SLOTS}")
@@ -197,21 +206,11 @@ def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
         )
         return masks, states, overflow
 
-    def scan_step(carry, ev):
+    def _force_phase(carry, is_force, slot):
+        """Shared closure+FORCE tail — identical for the legacy and
+        macro streams (the latch phases reach the same registers)."""
         (masks, states, slot_f, slot_a, slot_b, slot_open, ok, overflow,
          dirty) = carry
-        etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
-        is_open = etype == EV_OPEN
-        is_force = etype == EV_FORCE
-
-        onehot = slot_ids == slot  # [W]
-        upd = onehot & is_open
-        slot_f = jnp.where(upd, f, slot_f)
-        slot_a = jnp.where(upd, a, slot_a)
-        slot_b = jnp.where(upd, b, slot_b)
-        slot_open = jnp.where(upd, True, slot_open)
-        dirty = dirty | is_open
-
         # Closure only when an OPEN happened since the last closure: a
         # closed frontier stays closed under FORCE kill+clear (every
         # extension of a surviving configuration is a superset, so it
@@ -237,14 +236,56 @@ def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
                               killed_m & ~bitvec[None, :], killed_m)
         alive = jnp.any(cleared_m[:, K - 1] != _SENT)
         ok = ok & (~is_force | alive)
-        slot_open = slot_open & ~(onehot & is_force)
+        slot_open = slot_open & ~((slot_ids == slot) & is_force)
         # Clearing the recycled bit can merge configurations into
         # duplicates; they stay in place and merge for free at the next
         # closure's dedup (the tag-based fixpoint test is exact under
         # duplicates, so no per-event re-dedup is needed — measured ~25%
         # of kernel time when it was).
         return (cleared_m, states, slot_f, slot_a, slot_b, slot_open,
-                ok, overflow, dirty), None
+                ok, overflow, dirty)
+
+    if macro_p is None:
+        def scan_step(carry, ev):
+            (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+             overflow, dirty) = carry
+            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+            is_open = etype == EV_OPEN
+            is_force = etype == EV_FORCE
+
+            upd = (slot_ids == slot) & is_open
+            slot_f = jnp.where(upd, f, slot_f)
+            slot_a = jnp.where(upd, a, slot_a)
+            slot_b = jnp.where(upd, b, slot_b)
+            slot_open = jnp.where(upd, True, slot_open)
+            dirty = dirty | is_open
+
+            carry = _force_phase(
+                (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+                 overflow, dirty), is_force, slot)
+            return carry, None
+    else:
+        P = int(macro_p)
+
+        def scan_step(carry, row):
+            (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+             overflow, dirty) = carry
+            mtype, fslot, n, pslot, pf, pa, pb = _macro_cols(row, P)
+            is_force = mtype == EV_FORCE
+
+            # Vectorized multi-slot latch (≤P opens, distinct slots).
+            eq, upd = _macro_select(slot_ids, pslot,
+                                    jnp.arange(P, dtype=jnp.int32) < n)
+            slot_f = _macro_latch_i32(eq, upd, slot_f, pf)
+            slot_a = _macro_latch_i32(eq, upd, slot_a, pa)
+            slot_b = _macro_latch_i32(eq, upd, slot_b, pb)
+            slot_open = slot_open | upd
+            dirty = dirty | (n > 0)
+
+            carry = _force_phase(
+                (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+                 overflow, dirty), is_force, fslot)
+            return carry, None
 
     def init():
         masks = jnp.full((C, K), _SENT, dtype=jnp.uint32).at[0].set(
@@ -266,13 +307,16 @@ def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
 
 
 def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
-                         n_slots: int = MAX_SLOTS):
+                         n_slots: int = MAX_SLOTS,
+                         macro_p: Optional[int] = None):
     """Build a jittable single-history checker.
 
-    Returns fn(events:[E,5] int32) -> (valid: bool, overflow: bool).
-    See `sort_step_parts` for the kernel mechanics and shape knobs.
+    Returns fn(events:[E,5] int32) -> (valid: bool, overflow: bool)
+    (macro_p: [E_mac, 3+4·P] macro rows instead). See
+    `sort_step_parts` for the kernel mechanics and shape knobs.
     """
-    init, scan_step, verdict = sort_step_parts(model, n_configs, n_slots)
+    init, scan_step, verdict = sort_step_parts(model, n_configs, n_slots,
+                                               macro_p)
 
     def check(events):
         carry, _ = lax.scan(scan_step, init(), events,
@@ -297,21 +341,24 @@ _KERNEL_CACHE: dict = {}
 
 
 def make_batch_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
-                       n_slots: int = MAX_SLOTS, jit: bool = True):
+                       n_slots: int = MAX_SLOTS, jit: bool = True,
+                       macro_p: Optional[int] = None):
     """vmapped batch variant: fn(events:[B,E,5]) -> (valid[B], overflow[B]).
 
-    Kernels are cached by (model identity, C, W): jax.jit caches traces per
-    function object, so handing it a fresh closure per call would recompile
-    every time. Model identity = `Model.cache_key()`.
+    Kernels are cached by (model identity, C, W, P): jax.jit caches
+    traces per function object, so handing it a fresh closure per call
+    would recompile every time. Model identity = `Model.cache_key()`;
+    `macro_p` selects the macro-event row format (a P bucket is a
+    distinct compiled shape, like rows/events).
     """
     # scan_unroll() keys the cache (same invariant as dense_scan's):
     # the build closure resolves it at trace time, so an env change
     # mid-process must map to a distinct compiled kernel.
     key = (*model.cache_key(), int(n_configs), int(n_slots), jit,
-           scan_unroll())
+           scan_unroll(), macro_p)
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
-        single = make_history_checker(model, n_configs, n_slots)
+        single = make_history_checker(model, n_configs, n_slots, macro_p)
         fn = jax.vmap(single)
         if jit:
             fn = jax.jit(fn)
@@ -333,7 +380,7 @@ def sort_chunk_carry_bytes(n_configs: int, n_slots: int) -> int:
 
 def make_sort_chunk_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
                             n_slots: int = MAX_SLOTS, jit: bool = True,
-                            mesh=None):
+                            mesh=None, macro_p: Optional[int] = None):
     """Chunked twin of `make_batch_checker` for the wavefront scheduler
     (checker/schedule.py). Returns (init_fn, step_fn):
 
@@ -357,11 +404,11 @@ def make_sort_chunk_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
     a measurably slower program than the explicit wrap); callers pad
     the batch to a multiple of the mesh size."""
     key = ("chunk", *model.cache_key(), int(n_configs), int(n_slots), jit,
-           scan_unroll(), mesh)
+           scan_unroll(), mesh, macro_p)
     fns = _KERNEL_CACHE.get(key)
     if fns is None:
         init, scan_step, verdict = sort_step_parts(model, n_configs,
-                                                   n_slots)
+                                                   n_slots, macro_p)
 
         def init_one(n_ev):
             return {"inner": init(),

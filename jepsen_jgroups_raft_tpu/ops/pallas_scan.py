@@ -68,44 +68,57 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..history.packing import EV_FORCE, EV_OPEN
+from .dense_scan import macro_row_ints
 
 #: Lane budget: T·S targets the 128-lane vector axis.
 _LANE_TARGET = 128
 
 #: VMEM budget for one program's event block (bytes). Conservative slice
-#: of ~16 MiB usable VMEM: events dominate ([5·E, C] int32 after the
-#: host's lane expansion — C ≤ 128 lanes, so ≤ 2560·E bytes); the
-#: frontier itself is ≤ 2^10 × 128 × 4 B = 512 KiB.
+#: of ~16 MiB usable VMEM: events dominate ([R·E, C] int32 after the
+#: host's lane expansion — C ≤ 128 lanes and R = 5 legacy lanes or
+#: 3 + 4·P macro lanes); the frontier itself is ≤ 2^10 × 128 × 4 B =
+#: 512 KiB.
 _EVENTS_VMEM_BUDGET = 6 << 20
 
 
-def tile_histories(n_states: int, n_events: int) -> int:
+def tile_histories(n_states: int, n_events: int,
+                   row_ints: int = 5) -> int:
     """Histories per grid program: fill the lane axis, stay inside the
     events VMEM budget, power of two for stable compile shapes. The
-    lane-expanded event block is [5·E, T·S] int32, so VMEM charges
-    T·S·E·20 bytes — n_states now scales the block (each history's
+    lane-expanded event block is [R·E, T·S] int32 (R = `row_ints`: 5
+    legacy fields, or `macro_row_ints(P)` macro lanes), so VMEM charges
+    T·S·E·R·4 bytes — n_states scales the block too (each history's
     fields are replicated across its S lanes)."""
     by_lanes = max(1, _LANE_TARGET // max(1, int(n_states)))
     by_vmem = max(1, _EVENTS_VMEM_BUDGET
-                  // max(1, int(n_events) * 5 * 4 * int(n_states)))
+                  // max(1, int(n_events) * int(row_ints) * 4
+                        * int(n_states)))
     t = 1
     while t * 2 <= min(by_lanes, by_vmem):
         t *= 2
     return t
 
 
-def _build_kernel(model, W: int, S: int, E: int, T: int):
+def _build_kernel(model, W: int, S: int, E: int, T: int,
+                  macro_p=None):
     """Kernel body over one T-history tile, closed over static shapes.
 
-    Refs: events_ref [5·E, C] (row 5e+k = field k of event e as a lane
-    row, this tile's block), val_ref / out_ref [G, C] (FULL arrays,
-    constant index map — Mosaic's block rule demands sublane dims be
-    multiples of 8 or whole-array, and these are a few rows; each
-    program touches only its program_id row). C = T·S; history t owns
-    lanes [t·S, (t+1)·S); every per-history scalar is replicated across
-    its block's lanes."""
+    Refs: events_ref [R·E, C] (row R·e+k = field k of event e as a lane
+    row, this tile's block; R = 5 legacy fields or 3 + 4·P macro
+    lanes), val_ref / out_ref [G, C] (FULL arrays, constant index map —
+    Mosaic's block rule demands sublane dims be multiples of 8 or
+    whole-array, and these are a few rows; each program touches only
+    its program_id row). C = T·S; history t owns lanes [t·S, (t+1)·S);
+    every per-history scalar is replicated across its block's lanes.
+
+    `macro_p`: consume macro-event rows (history/packing.py
+    macro_compact) — a static-P-unrolled multi-slot latch, then the
+    identical closure+FORCE; the payload lanes arrive pre-expanded by
+    `_expand_lane_rows` exactly like the legacy fields, so Mosaic
+    never sees a new reshape."""
     M = 1 << W
     C = T * S
+    R = 5 if macro_p is None else macro_row_ints(macro_p)
 
     def kernel(events_ref, val_ref, out_ref):
         val_row = val_ref[pl.ds(pl.program_id(0), 1), :]  # [1, C]
@@ -138,19 +151,38 @@ def _build_kernel(model, W: int, S: int, E: int, T: int):
 
         def event_step(e, carry):
             F, slot_f, slot_a, slot_b, slot_open, ok_row, dirty_row = carry
-            ev = events_ref[pl.ds(e * 5, 5), :]           # [5, C]
-            etype_row, slot_row = ev[0:1, :], ev[1:2, :]
-            f_row, a_row, b_row = ev[2:3, :], ev[3:4, :], ev[4:5, :]
-            is_open = (etype_row == EV_OPEN).astype(jnp.int32)
-            is_force = (etype_row == EV_FORCE).astype(jnp.int32)
+            ev = events_ref[pl.ds(e * R, R), :]           # [R, C]
+            if macro_p is None:
+                etype_row, slot_row = ev[0:1, :], ev[1:2, :]
+                f_row, a_row, b_row = ev[2:3, :], ev[3:4, :], ev[4:5, :]
+                is_open = (etype_row == EV_OPEN).astype(jnp.int32)
+                is_force = (etype_row == EV_FORCE).astype(jnp.int32)
 
-            upd = ((w_iota == slot_row).astype(jnp.int32) *
-                   is_open)                               # [W, C]
-            slot_f = slot_f * (1 - upd) + f_row * upd
-            slot_a = slot_a * (1 - upd) + a_row * upd
-            slot_b = slot_b * (1 - upd) + b_row * upd
-            slot_open = jnp.maximum(slot_open, upd)
-            dirty_row = jnp.maximum(dirty_row, is_open)
+                upd = ((w_iota == slot_row).astype(jnp.int32) *
+                       is_open)                           # [W, C]
+                slot_f = slot_f * (1 - upd) + f_row * upd
+                slot_a = slot_a * (1 - upd) + a_row * upd
+                slot_b = slot_b * (1 - upd) + b_row * upd
+                slot_open = jnp.maximum(slot_open, upd)
+                dirty_row = jnp.maximum(dirty_row, is_open)
+            else:
+                # Macro row: [mtype, force_slot, n_opens] + P payloads.
+                # Static-P-unrolled multi-slot latch (slots within a
+                # macro are distinct, so payload order is immaterial).
+                mtype_row, slot_row = ev[0:1, :], ev[1:2, :]
+                n_row = ev[2:3, :]
+                is_force = (mtype_row == EV_FORCE).astype(jnp.int32)
+                for j in range(macro_p):
+                    pj = ev[3 + 4 * j:7 + 4 * j, :]       # [4, C]
+                    valid_j = (n_row > j).astype(jnp.int32)
+                    upd = ((w_iota == pj[0:1, :]).astype(jnp.int32) *
+                           valid_j)                       # [W, C]
+                    slot_f = slot_f * (1 - upd) + pj[1:2, :] * upd
+                    slot_a = slot_a * (1 - upd) + pj[2:3, :] * upd
+                    slot_b = slot_b * (1 - upd) + pj[3:4, :] * upd
+                    slot_open = jnp.maximum(slot_open, upd)
+                dirty_row = jnp.maximum(dirty_row,
+                                        (n_row > 0).astype(jnp.int32))
 
             Ts = [transition(w, slot_f, slot_a, slot_b, slot_open)
                   for w in range(W)]
@@ -225,30 +257,33 @@ def _build_kernel(model, W: int, S: int, E: int, T: int):
 
 
 def _expand_lane_rows(events, T: int, S: int):
-    """[Bp, E, 5] int32 → [G·5·E, C] lane rows (G = Bp/T, C = T·S):
-    tile g's row 5e+k holds field k of event e, history t's scalar
-    replicated across lanes [t·S, (t+1)·S). Runs as jnp INSIDE the
-    jitted call — the compact [Bp, E, 5] array crosses the (tunneled)
-    host↔device link and XLA expands on device; Mosaic's no-reshape
-    rule only binds inside the pallas kernel."""
-    Bp, E, _ = events.shape
+    """[Bp, E, R] int32 → [G·R·E, C] lane rows (G = Bp/T, C = T·S):
+    tile g's row R·e+k holds field k of event e, history t's scalar
+    replicated across lanes [t·S, (t+1)·S). R is whatever the stream
+    carries — 5 legacy fields or 3 + 4·P macro lanes; the macro
+    payload rows grow the SAME pre-expansion, so Mosaic sees no new
+    reshape. Runs as jnp INSIDE the jitted call — the compact
+    [Bp, E, R] array crosses the (tunneled) host↔device link and XLA
+    expands on device; Mosaic's no-reshape rule only binds inside the
+    pallas kernel."""
+    Bp, E, R = events.shape
     G = Bp // T
-    # (G, T, E, 5) → (G, E, 5, T) → repeat S on lanes → (G·5E, T·S)
+    # (G, T, E, R) → (G, E, R, T) → repeat S on lanes → (G·R·E, T·S)
     lanes = jnp.repeat(
-        events.reshape(G, T, E, 5).transpose(0, 2, 3, 1), S, axis=3)
-    return lanes.reshape(G * E * 5, T * S)
+        events.reshape(G, T, E, R).transpose(0, 2, 3, 1), S, axis=3)
+    return lanes.reshape(G * E * R, T * S)
 
 
 _CALL_CACHE: dict = {}
 
 
 def _build_call(model, W: int, S: int, E: int, T: int, G: int,
-                interpret: bool):
-    key = (*model.cache_key(), W, S, E, T, G, interpret)
+                R: int, interpret: bool, macro_p):
+    key = (*model.cache_key(), W, S, E, T, G, R, interpret, macro_p)
     cached = _CALL_CACHE.get(key)
     if cached is not None:
         return cached
-    kernel = _build_kernel(model, W, S, E, T)
+    kernel = _build_kernel(model, W, S, E, T, macro_p)
     C = T * S
 
     def call(events, val_rows):
@@ -257,7 +292,7 @@ def _build_call(model, W: int, S: int, E: int, T: int, G: int,
             kernel,
             grid=(G,),
             in_specs=[
-                pl.BlockSpec((E * 5, C), lambda g: (g, 0),
+                pl.BlockSpec((E * R, C), lambda g: (g, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((G, C), lambda g: (0, 0),
                              memory_space=pltpu.VMEM),
@@ -274,14 +309,19 @@ def _build_call(model, W: int, S: int, E: int, T: int, G: int,
 
 
 def make_pallas_batch_checker(model, n_slots: int, n_states: int,
-                              n_events: int, interpret: bool = False):
+                              n_events: int, interpret: bool = False,
+                              macro_p=None):
     """fn(events [B,E,5] int32, val_of [B,S] int32) -> (valid[B] bool,
     overflow[B] bool) — the dense-domain check as one Pallas launch, one
     grid program per T-history tile. Like the dense kernel, overflow is
     structurally impossible. `interpret` runs the Pallas interpreter
-    (CPU-correctness mode, used by the differential tests)."""
+    (CPU-correctness mode, used by the differential tests). `macro_p`
+    consumes macro-event batches ([B, E_mac, 3+4·P] from
+    `pack_macro_batch`) instead — the tile budget charges the wider
+    rows, everything else is unchanged."""
     W, S, E = int(n_slots), int(n_states), int(n_events)
-    T_cap = tile_histories(S, E)
+    R = 5 if macro_p is None else macro_row_ints(macro_p)
+    T_cap = tile_histories(S, E, R)
 
     def check(events, val_of):
         events = np.asarray(events, np.int32)
@@ -289,12 +329,13 @@ def make_pallas_batch_checker(model, n_slots: int, n_states: int,
         B = events.shape[0]
         E = events.shape[1]
         if E % 8:
-            # Mosaic block rule: the event block's sublane dim (5·E)
-            # must divide by 8 when the grid has >1 tile. EV_PAD rows
-            # are no-ops, so round E up (the kernel cache keys on E).
+            # Mosaic block rule: the event block's sublane dim (R·E)
+            # must divide by 8 when the grid has >1 tile; R is odd in
+            # both formats, so E itself must. EV_PAD rows are no-ops,
+            # so round E up (the kernel cache keys on E).
             E8 = ((E + 7) // 8) * 8
             events = np.concatenate(
-                [events, np.zeros((B, E8 - E, 5), np.int32)], axis=1)
+                [events, np.zeros((B, E8 - E, R), np.int32)], axis=1)
             E = E8
         # Clamp the tile to the batch: a 2-history long-event group must
         # not pay a 32-lane tile of per-event matmul work (the kernel
@@ -307,12 +348,13 @@ def make_pallas_batch_checker(model, n_slots: int, n_states: int,
             # Tile padding: EV_PAD streams are no-ops, pad verdicts are
             # discarded below.
             events = np.concatenate(
-                [events, np.zeros((Bp - B, E, 5), np.int32)])
+                [events, np.zeros((Bp - B, E, R), np.int32)])
             val_of = np.concatenate(
                 [val_of, np.zeros((Bp - B, S), np.int32)])
         G = Bp // T
         val_rows = np.ascontiguousarray(val_of.reshape(G, T * S))
-        call = _build_call(model, W, S, E, T, G, bool(interpret))
+        call = _build_call(model, W, S, E, T, G, R, bool(interpret),
+                           macro_p)
         ok_rows = call(jnp.asarray(events), jnp.asarray(val_rows))
         # History t's verdict is lane t·S of its tile row (block-
         # replicated; any lane would do). Stays a LAZY device array —

@@ -56,8 +56,8 @@ from jax import lax
 
 from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
 from .dense_scan import (DENSE_MAX_CELLS, DENSE_MAX_SLOTS, DENSE_MAX_STATES,
-                         _bit_table, _closure_fixpoint, _make_force_branches,
-                         _pad_domains, scan_unroll)
+                         _closure_fixpoint, _force_arith, _pad_domains,
+                         scan_unroll)
 
 #: Segment the stream only when it is long enough to be worth the basis
 #: overhead; shorter histories take the plain dense kernel.
@@ -192,8 +192,6 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
     W, S, E = int(n_slots), int(n_states), int(n_events)
     M = 1 << W
     slot_ids = jnp.arange(W, dtype=jnp.int32)
-    bit_table = _bit_table(M, W)
-    force_branches = _make_force_branches(bit_table, W, S)
 
     def expand_w(w, F, Te):
         Fb = F.reshape(M >> (w + 1), 2, 1 << w, S)
@@ -230,8 +228,9 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
         F = _closure_fixpoint(W, sweep, F, is_force & dirty)
         dirty = dirty & ~is_force
 
-        slot_w = jnp.clip(slot, 0, W - 1)
-        F_forced, _ = lax.switch(slot_w, force_branches, F)
+        # Switch-free dispatch (ops/dense_scan._force_arith): the old
+        # lax.switch evaluated all W branches under the segment vmap.
+        F_forced, _ = _force_arith(F, jnp.clip(slot, 0, W - 1))
         F = jnp.where(is_force, F_forced, F)
         slot_open = slot_open & ~(onehot & is_force)
         return (F, T, slot_open, dirty, val_of), None

@@ -113,7 +113,8 @@ _CACHE: dict = {}
 def sharded_batch_checker(model, mesh: Mesh,
                           n_configs: int = DEFAULT_N_CONFIGS,
                           n_slots: int = MAX_SLOTS,
-                          axis_name: str = BATCH_AXIS):
+                          axis_name: str = BATCH_AXIS,
+                          macro_p: Optional[int] = None):
     """Build fn(events:[B,E,5], real:[B] bool) ->
     (ok[B], overflow[B], n_valid, n_unknown).
 
@@ -122,16 +123,19 @@ def sharded_batch_checker(model, mesh: Mesh,
     n_valid/n_unknown are scalar `psum` aggregates (the ICI collective).
     `real` masks padding rows out of the aggregates — EV_PAD histories are
     trivially valid, so counting them would silently inflate n_valid.
+    `macro_p` selects the macro-event row format ([B, E_mac, 3+4·P];
+    history/packing.py) — a distinct compiled shape, so it keys the
+    kernel cache like every other bucketed dim.
     """
     # scan_unroll() in the key: the wrapped kernel bakes it in at trace
     # time (same invariant as every ops/ kernel cache).
     key = (*model.cache_key(), int(n_configs), int(n_slots),
-           tuple(mesh.devices.flat), axis_name, scan_unroll())
+           tuple(mesh.devices.flat), axis_name, scan_unroll(), macro_p)
     fn = _CACHE.get(key)
     if fn is not None:
         return fn
 
-    single = make_history_checker(model, n_configs, n_slots)
+    single = make_history_checker(model, n_configs, n_slots, macro_p)
     vm = jax.vmap(single)
 
     def local_step(ev, real):  # ev: [B/n, E, 5] local shard
@@ -157,20 +161,22 @@ def sharded_batch_checker(model, mesh: Mesh,
 
 
 def sharded_dense_checker(model, mesh: Mesh, kind: str, n_slots: int,
-                          n_states: int, axis_name: str = BATCH_AXIS):
+                          n_states: int, axis_name: str = BATCH_AXIS,
+                          macro_p: Optional[int] = None):
     """Dense-bitset variant of `sharded_batch_checker`:
     fn(events [B,E,5], val_of [B,S], real [B] bool) -> (ok[B],
     overflow[B], n_valid, n_unknown). Same mesh layout; the per-history
     domain table (or the mask-mode dummy) and the padding mask shard with
-    the batch."""
+    the batch; `macro_p` keys the macro-event row format."""
     key = ("dense", kind, *model.cache_key(), int(n_slots),
            int(n_states), tuple(mesh.devices.flat), axis_name,
-           scan_unroll())
+           scan_unroll(), macro_p)
     fn = _CACHE.get(key)
     if fn is not None:
         return fn
 
-    vm = jax.vmap(make_dense_single_checker(model, kind, n_slots, n_states))
+    vm = jax.vmap(make_dense_single_checker(model, kind, n_slots, n_states,
+                                            macro_p))
 
     def local_step(ev, val_of, real):
         ok, overflow = vm(ev, val_of)
@@ -199,7 +205,7 @@ def _real_mask(B_real: int, B_padded: int) -> np.ndarray:
 
 
 def _run_once(model, events: np.ndarray, mesh: Mesh, n_configs: int,
-              n_slots: int):
+              n_slots: int, macro_p: Optional[int] = None):
     """One sharded launch at a fixed frontier capacity, with mesh-size
     padding handled. B is bucketed (pow2+midpoint series) so escalation rungs
     (whose subset sizes vary run to run) hit the jit cache instead of
@@ -211,7 +217,8 @@ def _run_once(model, events: np.ndarray, mesh: Mesh, n_configs: int,
     msharding = NamedSharding(mesh, P(axis_name))
     dev_events = jax.device_put(events, sharding)
     dev_mask = jax.device_put(_real_mask(B, events.shape[0]), msharding)
-    fn = sharded_batch_checker(model, mesh, n_configs, n_slots, axis_name)
+    fn = sharded_batch_checker(model, mesh, n_configs, n_slots, axis_name,
+                               macro_p)
     ok, overflow, _, _ = fn(dev_events, dev_mask)
     # One sharded launch per rung; the ladder blocks here by design.
     return np.asarray(ok)[:B], np.asarray(overflow)[:B]  # lint: allow(host-sync)
@@ -221,13 +228,15 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
                         n_configs: Optional[int] = None,
                         n_slots: int = MAX_SLOTS,
                         dense: Optional[tuple] = None,
-                        defer: bool = False):
+                        defer: bool = False,
+                        macro_p: Optional[int] = None):
     """Check a packed event batch across the mesh.
 
-    events: [B, E, 5] int32 (history/packing.py layout). Pads B up to a
-    multiple of the mesh size with EV_PAD histories (trivially valid, no
-    FORCE events → sliced off afterwards). Returns (ok[B], overflow[B],
-    n_valid, n_unknown) host values corrected for padding.
+    events: [B, E, 5] int32 (history/packing.py layout), or a macro
+    batch [B, E_mac, 3+4·P] with `macro_p=P` (pack_macro_batch). Pads B
+    up to a multiple of the mesh size with EV_PAD histories (trivially
+    valid, no FORCE events → sliced off afterwards). Returns (ok[B],
+    overflow[B], n_valid, n_unknown) host values corrected for padding.
 
     `defer=True` returns a zero-arg finalizer instead: the dense-plan
     launch is dispatched asynchronously and the finalizer blocks for the
@@ -256,7 +265,7 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
         vsharding = NamedSharding(mesh, P(axis_name, None))
         msharding = NamedSharding(mesh, P(axis_name))
         fn = sharded_dense_checker(model, mesh, dense.kind, dense.n_slots,
-                                   dense.n_states, axis_name)
+                                   dense.n_states, axis_name, macro_p)
         mask = _real_mask(B, events.shape[0])
         ok, overflow, n_valid, _ = fn(jax.device_put(events, sharding),
                                       jax.device_put(val_of, vsharding),
@@ -275,7 +284,8 @@ def check_batch_sharded(model, events: np.ndarray, mesh: Optional[Mesh] = None,
     overflow = np.zeros((B,), dtype=bool)
     remaining = np.arange(B)
     for rung, C in enumerate(ladder):
-        r_ok, r_ovf = _run_once(model, events[remaining], mesh, C, n_slots)
+        r_ok, r_ovf = _run_once(model, events[remaining], mesh, C, n_slots,
+                                macro_p)
         ok[remaining] = r_ok
         overflow[remaining] = r_ovf
         # escalate only undecided rows: overflowed AND not proven valid
