@@ -634,6 +634,160 @@ def run_suite(platform_note: str) -> None:
     timed("5: single 100k-op history", CasRegister(), [h])
 
 
+def run_service(platform_note: str) -> None:
+    """ISSUE-5 service throughput mode (`python bench.py --service`):
+    drive graftd over its real HTTP surface with sustained concurrent
+    submissions and report req/s + queue/batching/latency evidence.
+
+    Shape knobs (env): JGRAFT_SERVICE_BENCH_REQUESTS total requests per
+    rep (default 64), _HISTORIES per request (default 4), _OPS per
+    history (default 200), _CLIENTS concurrent submitters (default 8 —
+    the acceptance bar's concurrency). Reps follow the north-star
+    discipline: one untimed warm-up (XLA compile + daemon spin-up),
+    then best-of-N with the cold/warm split and host fingerprint
+    stamped, so service numbers are comparable across the known host
+    drift exactly like the batch rows (CHANGES.md PR 3 note)."""
+    import random as _random
+    import threading
+
+    import jax
+
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.service import (CheckingService,
+                                                 ServiceClient, ServiceError,
+                                                 serve_in_thread)
+
+    n_requests = int(os.environ.get("JGRAFT_SERVICE_BENCH_REQUESTS", "64"))
+    n_hists = int(os.environ.get("JGRAFT_SERVICE_BENCH_HISTORIES", "4"))
+    n_ops = int(os.environ.get("JGRAFT_SERVICE_BENCH_OPS", "200"))
+    n_clients = int(os.environ.get("JGRAFT_SERVICE_BENCH_CLIENTS", "8"))
+
+    rng = _random.Random(20260803)
+    # Per-request distinct histories: identical payloads would measure
+    # the result cache, not the scheduler (cache hits are reported
+    # separately). A small shared pool keeps synthesis off the clock.
+    pool = [random_valid_history(rng, "register", n_ops=n_ops, n_procs=5,
+                                 crash_p=0.05, max_crashes=3)
+            for _ in range(n_requests * n_hists)]
+    payloads = [pool[i * n_hists:(i + 1) * n_hists]
+                for i in range(n_requests)]
+
+    # cache_capacity=0: reps resubmit the same payload pool, and with
+    # the cache on every timed rep after the warm-up would measure the
+    # fingerprint LRU, not the batching scheduler. The cache-hit path
+    # has its own test coverage; this row measures real scheduling.
+    service = CheckingService(store_root=None, name="graftd-bench",
+                              cache_capacity=0)
+    httpd, port, _t = serve_in_thread(service)
+    client_url = f"http://127.0.0.1:{port}"
+    _CLEANUP.append(httpd.server_close)
+    _CLEANUP.append(service.shutdown)
+
+    def wave():
+        """One rep: n_requests submitted from n_clients threads, every
+        verdict awaited. Returns (wall_s, latencies, rejected,
+        stats_delta) — the daemon counters are snapshotted per wave so
+        the emitted batches/cache numbers describe the SAME rep as
+        time_s/req_s, not an accumulation across all best_of reps."""
+        s0 = service.stats()
+        latencies: list = []
+        rejected = [0]
+        lock = threading.Lock()
+        idx = iter(range(n_requests))
+
+        def submitter():
+            cl = ServiceClient(client_url, timeout=60.0)
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                t0 = time.perf_counter()
+                while True:
+                    try:
+                        rec = cl.submit(payloads[i], workload="register")
+                        break
+                    except ServiceError as e:
+                        if e.status != 429:
+                            raise
+                        with lock:
+                            rejected[0] += 1
+                        time.sleep(min(e.retry_after_s or 0.5, 2.0))
+                rec = cl.result(rec["id"], wait_s=60.0)
+                while rec["status"] not in ("done", "failed", "cancelled"):
+                    rec = cl.result(rec["id"], wait_s=60.0)
+                assert rec["status"] == "done", rec
+                assert rec["valid?"] is True, rec
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=submitter, daemon=True)
+                   for _ in range(n_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        s1 = service.stats()
+        delta = {k: s1[k] - s0[k] for k in
+                 ("batches", "batched_requests", "cache_hits")}
+        return wall, latencies, rejected[0], delta
+
+    wave()  # warm-up: compile + daemon spin-up (uncounted, like run())
+    beat()
+    (wall, latencies, rejected, delta), rep_times = best_of(wave)
+    stats = service.stats()
+
+    httpd.shutdown()
+    httpd.server_close()
+    service.shutdown(wait=True)
+    _CLEANUP.remove(httpd.server_close)
+    _CLEANUP.remove(service.shutdown)
+
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    p99 = latencies[min(len(latencies) - 1,
+                        int(0.99 * len(latencies)))] if latencies else 0.0
+    batches = delta["batches"]
+    batched = delta["batched_requests"]
+    emit({
+        "metric": "service_requests_per_sec",
+        "value": round(n_requests / wall, 2),
+        "unit": "req/s",
+        "n_requests": n_requests,
+        "histories_per_request": n_hists,
+        "n_ops": n_ops,
+        "client_concurrency": n_clients,
+        "time_s": round(wall, 3),
+        "p50_latency_s": round(p50, 4),
+        "p99_latency_s": round(p99, 4),
+        # the daemon's submit-time high-water mark (incl. warm-up) —
+        # completion-time sampling reads a mostly-drained queue.
+        "queue_depth_hw": stats["max_queue_depth"],
+        "queue_capacity": stats["queue_capacity"],
+        "rejected_submissions": rejected,
+        "batches": batches,
+        "batched_requests": batched,
+        "batch_occupancy_mean": round(batched / batches, 3) if batches
+        else 0.0,
+        "cache_hits": delta["cache_hits"],
+        # process-lifetime gauges (not per-rep): degrades/restarts are
+        # service-health evidence for the whole bench run.
+        "degraded_batches": stats["degraded_batches"],
+        "worker_restarts": stats["worker_restarts"],
+        # Same host-drift armor as the batch rows (ISSUE-4 satellites):
+        # best rep + full spread + cold/warm split + host fingerprint.
+        "rep_times_s": [round(t, 3) for t in rep_times],
+        **cold_warm(rep_times),
+        "host_fingerprint": host_fingerprint(),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "platform_note": platform_note,
+    })
+
+
 def _record_real_run(min_keys: int, time_limit: float = 90.0):
     """Drive a real native cluster (multi-register + partition nemesis)
     long enough to touch `min_keys` keys; return the store dir."""
@@ -744,6 +898,10 @@ def main() -> None:
     if "--suite" in sys.argv:
         run_suite(note)
         persist_artifact("suite")
+        return
+    if "--service" in sys.argv:
+        run_service(note)
+        persist_artifact("service")
         return
     n_histories = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
     n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 1000

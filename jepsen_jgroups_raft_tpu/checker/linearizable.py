@@ -133,8 +133,34 @@ def check_histories(
     SLOT_BUCKETS 31/63/95/127) — per-event closure work scales with C×W,
     so a snug window is a direct kernel-speed win.
     """
-    results = _check_histories(histories, model, algorithm, n_configs,
-                               n_slots, witness, max_cpu_configs)
+    encs = [encode_history(h, model) for h in histories]
+    return check_encoded(encs, model, algorithm, n_configs, n_slots,
+                         witness, max_cpu_configs)
+
+
+def check_encoded(
+    encs: Sequence[EncodedHistory],
+    model,
+    algorithm: str = "auto",
+    n_configs: Optional[int] = None,
+    n_slots: Optional[int] = None,
+    witness: bool = False,
+    max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
+) -> list[dict]:
+    """Pack-once/check-many entry: verify histories that are ALREADY
+    encoded (`history.packing.encode_history`), one result dict each.
+
+    This is the seam the checking service (service/scheduler.py) batches
+    through: graftd encodes every submission exactly once at admission
+    (the encoding bytes are also its result-cache fingerprint), then
+    re-enters here with the concatenation of many tenants' encodings —
+    the dense grouping, pow2+midpoint bucketing, and chunked wavefront
+    below treat those foreign rows exactly like a single caller's batch
+    (rows are independent along the batch axis; doc/checker-design.md
+    §8). `check_histories` is the encode-then-delegate wrapper.
+    """
+    results = _check_encoded(encs, model, algorithm, n_configs,
+                             n_slots, witness, max_cpu_configs)
     note = degraded_note()
     if note:
         # The platform silently degraded (TPU probe failed / tunnel
@@ -146,8 +172,8 @@ def check_histories(
     return results
 
 
-def _check_histories(
-    histories: Sequence[History],
+def _check_encoded(
+    encs: Sequence[EncodedHistory],
     model,
     algorithm: str = "auto",
     n_configs: Optional[int] = None,
@@ -155,7 +181,6 @@ def _check_histories(
     witness: bool = False,
     max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
 ) -> list[dict]:
-    encs = [encode_history(h, model) for h in histories]
     results: list[Optional[dict]] = [None] * len(encs)
 
     if algorithm == "dfs":
@@ -641,6 +666,24 @@ def _jx(valid, enc: EncodedHistory, secs: float,
         "concurrency-window": enc.n_slots,
         "time-s": secs,
     }
+
+
+def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
+                       max_cpu_configs: Optional[int]
+                       = DEFAULT_MAX_CPU_CONFIGS) -> dict:
+    """Host-only verdict ladder for one encoded history: the capped CPU
+    frontier first, the budgeted DFS when the frontier reports UNKNOWN —
+    never a device launch. This is graftd's degrade path (the service
+    re-checks a batch through it when the device pass raises mid-check),
+    mirroring `auto` mode's escalation order without re-entering jax."""
+    if enc.n_events == 0:
+        return {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
+    r = _check_cpu(enc, model, witness, max_cpu_configs)
+    if r.get("valid?") is UNKNOWN:
+        r2 = _check_dfs(enc, model, witness, max_steps=DEFAULT_DFS_BUDGET)
+        if r2["valid?"] is not UNKNOWN:
+            return r2
+    return r
 
 
 def _check_cpu(enc: EncodedHistory, model, witness: bool,

@@ -104,7 +104,7 @@ def _add_stats(**kw) -> None:
 
 
 @contextlib.contextmanager
-def stats_scope():
+def stats_scope(label: Optional[str] = None):
     """Explicit per-run counter scope (ISSUE-4 satellite): counters
     accumulated while the scope is active land in the yielded dict too,
     isolated from everything before it. `core/runner.run_test` wraps
@@ -113,8 +113,17 @@ def stats_scope():
     stores per-run counters instead of process-lifetime accumulation.
     Nesting-safe (scopes stack) and thread-safe; the process-wide
     totals that `consume_stats` serves (the bench's per-rep read) are
-    untouched."""
+    untouched.
+
+    `label` threads a caller identity through the scope (ISSUE-5: the
+    checking service labels each coalesced launch with the request ids
+    riding it — "graftd:req-a,req-b" — so per-request trace records can
+    attribute their shared launch's counters). The label is carried in
+    the yielded dict under the non-counter key ``"label"``; `_add_stats`
+    only ever touches counter keys, so it is never accumulated into."""
     scope = dict(_STATS_ZERO)
+    if label is not None:
+        scope["label"] = label
     with _STATS_LOCK:
         _SCOPES.append(scope)
     try:

@@ -204,6 +204,22 @@ def cmd_serve(args) -> int:
     return serve(args.store, host=args.host, port=args.port)
 
 
+def cmd_serve_checker(args) -> int:
+    """graftd: the always-on multi-tenant checking daemon (service/) —
+    queued admission, cross-request batching over the chunked scan,
+    degrade-to-CPU resilience. Trace records land in the same store/
+    layout the `serve` browser reads."""
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    from .service.http import serve_checker
+    return serve_checker(store_root=args.store, host=args.host,
+                         port=args.port, queue_capacity=args.queue,
+                         batch_wait=(args.batch_wait_ms / 1000.0
+                                     if args.batch_wait_ms is not None
+                                     else None))
+
+
 def cmd_check(args) -> int:
     """Re-verify recorded runs: store → load → per-key split → one
     on-device batch (BASELINE config #3's shape). Accepts run dirs or
@@ -243,6 +259,22 @@ def main(argv=None) -> int:
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--port", type=int, default=8080)
     s.set_defaults(fn=cmd_serve)
+    sc = sub.add_parser("serve-checker",
+                        help="graftd: always-on multi-tenant checking "
+                             "daemon (HTTP+JSON, cross-request batching)")
+    sc.add_argument("--store", default="store",
+                    help="trace-record root (browsable via `serve`)")
+    sc.add_argument("--host", default="0.0.0.0")
+    sc.add_argument("--port", type=int, default=8091)
+    sc.add_argument("--queue", type=int, default=None,
+                    help="admission queue capacity "
+                         "(default: JGRAFT_SERVICE_QUEUE or 64)")
+    sc.add_argument("--batch-wait-ms", type=int, default=None,
+                    help="batch-formation linger "
+                         "(default: JGRAFT_SERVICE_BATCH_WAIT_MS or 50)")
+    sc.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="pin the JAX backend for checking")
+    sc.set_defaults(fn=cmd_serve_checker)
     c = sub.add_parser("check",
                        help="re-verify recorded runs as one device batch")
     c.add_argument("paths", nargs="+",

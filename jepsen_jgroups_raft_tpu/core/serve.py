@@ -36,7 +36,12 @@ def _verdict(run: Path):
     try:
         with open(run / "results.json") as f:
             return json.load(f).get("valid?")
-    except Exception:
+    except (OSError, UnicodeDecodeError, json.JSONDecodeError):
+        # Exactly the ways reading a verdict can fail: missing file, or
+        # a results.json truncated/corrupted mid-write (including a cut
+        # inside a multi-byte UTF-8 sequence, which raises
+        # UnicodeDecodeError before the JSON parser even runs).
+        # Anything else is a bug that must surface, not render as "?".
         return None
 
 

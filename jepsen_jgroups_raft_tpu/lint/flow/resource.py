@@ -61,11 +61,17 @@ _ADOPT = {"append", "add", "insert", "put", "register", "setdefault",
 SCAN_FILES = ("deploy/ssh.py", "deploy/local.py", "core/runner.py",
               "core/db.py")
 
+#: The service tier (ISSUE-5) is scanned wholesale: graftd holds queue
+#: entries, per-call client sockets, trace file handles, and worker
+#: threads across exception paths, and it is long-lived — a per-request
+#: leak that a one-shot run never notices exhausts the daemon's fds.
+SCAN_PREFIXES = ("service/",)
+
 
 def applies_to(relpath: str) -> bool:
     rp = relpath.replace("\\", "/")
     rp = rp.split("jepsen_jgroups_raft_tpu/", 1)[-1]
-    return rp in SCAN_FILES
+    return rp in SCAN_FILES or rp.startswith(SCAN_PREFIXES)
 
 
 # ------------------------------------------------------------- predicates

@@ -57,9 +57,12 @@ CLASSIFIERS = {"classify_error", "with_errors"}
 LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
                "log"}
 
-#: Default CLI scan set, relative to the package root.
-SCAN_PREFIXES = ("client/", "workload/", "deploy/")
-SCAN_FILES = ("core/runner.py", "native/client.py")
+#: Default CLI scan set, relative to the package root. The service
+#: tier (graftd, ISSUE-5) and both stdlib HTTP servers are covered: a
+#: long-lived daemon is where a silently-swallowed broad except turns
+#: into an unexplained wedge instead of a crashed run.
+SCAN_PREFIXES = ("client/", "workload/", "deploy/", "service/")
+SCAN_FILES = ("core/runner.py", "native/client.py", "core/serve.py")
 
 
 def applies_to(relpath: str) -> bool:

@@ -1,0 +1,26 @@
+"""graftd: the multi-tenant checking service (ISSUE-5 tentpole).
+
+The paper's harness checks one recorded history per run; this package
+turns the checker into an always-on daemon that amortizes the chunked
+TPU scan (PR 3/4's ChunkLaunch dispatch, pow2+midpoint shape buckets,
+macro compaction) across many independent submissions:
+
+* request.py   — admission-time normalization: encode once, fingerprint
+                 the packed tensors, per-key split for independent
+                 workloads.
+* admission.py — bounded queue with reject-with-retry-after
+                 backpressure + the LRU result cache.
+* scheduler.py — cross-request shape-bucket batching over
+                 `checker.linearizable.check_encoded`, deadline/aging
+                 ordering, per-request cancellation, degrade-to-CPU.
+* daemon.py    — CheckingService: supervised worker, stats, store/
+                 trace records.
+* http.py      — stdlib HTTP+JSON surface (`serve-checker` CLI).
+* client.py    — tenant-side client (tests, bench --service).
+"""
+
+from .admission import QueueFull  # noqa: F401
+from .client import ServiceClient, ServiceError  # noqa: F401
+from .daemon import CheckingService  # noqa: F401
+from .http import make_server, serve_checker, serve_in_thread  # noqa: F401
+from .request import CheckRequest  # noqa: F401

@@ -1,0 +1,113 @@
+"""Thin HTTP client for graftd — stdlib http.client, JSON in/out.
+
+The tenant-side counterpart of service/http.py: tests, the bench's
+--service throughput mode, and any external submitter use this instead
+of hand-rolling requests. One connection per call (the daemon is
+ThreadingHTTPServer; connection reuse buys nothing at this scale and a
+stateless client survives daemon restarts for free).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from http.client import HTTPConnection
+from typing import Optional, Sequence
+
+
+class ServiceError(Exception):
+    """Non-2xx daemon answer. `status` is the HTTP code; `payload` the
+    decoded JSON body (carries `retry_after_s` on 429)."""
+
+    def __init__(self, status: int, payload: dict):
+        super().__init__(f"HTTP {status}: {payload.get('error', payload)}")
+        self.status = status
+        self.payload = payload
+
+    @property
+    def retry_after_s(self) -> Optional[float]:
+        v = self.payload.get("retry_after_s")
+        return float(v) if v is not None else None
+
+
+class ServiceClient:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        # base_url: http://host:port (path prefixes unsupported — the
+        # daemon serves at the root, like core/serve.py).
+        if "://" in base_url:
+            base_url = base_url.split("://", 1)[1]
+        self.netloc = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str,
+              body: Optional[dict] = None) -> dict:
+        conn = HTTPConnection(self.netloc, timeout=self.timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+        if resp.status >= 400:
+            raise ServiceError(resp.status, data)
+        return data
+
+    # ------------------------------------------------------- surface
+
+    def submit(self, histories: Sequence, workload: str = "register",
+               algorithm: str = "auto", deadline_ms: Optional[float] = None,
+               priority: int = 0) -> dict:
+        """Submit histories (History objects or op-dict lists); returns
+        the daemon's request record ({"id", "status", ...}). Raises
+        ServiceError on 429 (read `.retry_after_s`) or 400."""
+        rows = [h.to_dicts() if hasattr(h, "to_dicts") else list(h)
+                for h in histories]
+        return self._call("POST", "/submit", {
+            "workload": workload, "histories": rows,
+            "algorithm": algorithm, "deadline_ms": deadline_ms,
+            "priority": priority})
+
+    def submit_run_dir(self, run_dir: str, workload: Optional[str] = None,
+                       algorithm: str = "auto") -> dict:
+        return self._call("POST", "/submit", {
+            "run_dir": str(run_dir), "workload": workload,
+            "algorithm": algorithm})
+
+    def result(self, request_id: str,
+               wait_s: Optional[float] = None) -> dict:
+        path = f"/result?id={request_id}"
+        if wait_s is not None:
+            path += f"&wait_s={wait_s}"
+        return self._call("GET", path)
+
+    def cancel(self, request_id: str) -> dict:
+        return self._call("POST", "/cancel", {"id": request_id})
+
+    def stats(self) -> dict:
+        return self._call("GET", "/stats")
+
+    def healthz(self) -> dict:
+        return self._call("GET", "/healthz")
+
+    def check(self, histories: Sequence, workload: str = "register",
+              algorithm: str = "auto", timeout_s: float = 300.0,
+              poll_s: float = 0.05) -> dict:
+        """Submit-and-wait convenience: returns the terminal request
+        record (results included). Waits server-side in bounded slices
+        so one slow verdict cannot park the connection past the
+        daemon's handler cap."""
+        rec = self.submit(histories, workload=workload, algorithm=algorithm)
+        if rec.get("status") in ("done", "failed", "cancelled"):
+            return self.result(rec["id"])
+        deadline = time.monotonic() + timeout_s
+        while True:
+            rec = self.result(rec["id"], wait_s=min(
+                10.0, max(poll_s, deadline - time.monotonic())))
+            if rec.get("status") in ("done", "failed", "cancelled"):
+                return rec
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"request {rec['id']} still {rec.get('status')} after "
+                    f"{timeout_s:.0f}s")

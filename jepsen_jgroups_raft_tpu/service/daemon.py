@@ -1,0 +1,391 @@
+"""graftd: the always-on checking daemon.
+
+Owns the pieces the rest of the package provides — admission queue +
+result cache (service/admission.py), batching scheduler
+(service/scheduler.py), request records (service/request.py) — and adds
+the lifecycle: a supervised worker thread that drains the queue batch
+by batch, service-level stats (throughput, queue depth high-water,
+batch occupancy, latency percentiles, cache hits), and per-request
+trace records written into the existing ``store/`` layout
+(``store/<service>/<ts>-<reqid>/results.json``) so ``core/serve.py``
+browses service verdicts exactly like test runs.
+
+Failure stance:
+
+* A batch whose DEVICE path dies degrades to the host ladder inside
+  the scheduler — the request completes with ``platform-degraded``
+  stamped, it does not error.
+* A batch whose execution raises anyway (host fallback bug) fails only
+  that batch's requests, with the error recorded; the worker loop
+  continues.
+* The worker THREAD dying (anything escaping the loop) loses nothing:
+  the supervisor requeues the popped-but-unfinished batch, increments
+  ``worker_restarts``, and respawns the worker. Queued requests were
+  never popped, so they simply wait.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .admission import (AdmissionQueue, QueueFull, ResultCache,
+                        ServiceStopped)
+from .request import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, CheckRequest,
+                      admit, admit_run_dir)
+from .scheduler import BatchScheduler
+
+LOG = logging.getLogger("jgraft.service")
+
+#: Poll granularity of the worker loop (also the shutdown latency
+#: bound). The queue condition wakes the worker instantly on arrival;
+#: this only bounds how often an idle worker re-checks the stop flag.
+IDLE_POLL_S = 0.25
+
+#: Latency samples kept for the percentile window.
+LATENCY_WINDOW = 4096
+
+
+def retain_capacity() -> int:
+    """Terminal requests kept queryable after completion (the /result
+    retention window, JGRAFT_SERVICE_RETAIN). Bounded for the same
+    reason the queue is: an always-on daemon that retains every
+    finished request's histories and encodings grows RSS without
+    limit — the OOM the admission bound exists to prevent."""
+    from ..platform import env_int
+
+    return env_int("JGRAFT_SERVICE_RETAIN", 1024, minimum=1)
+
+
+class CheckingService:
+    """The daemon. `start()` spawns the supervised worker; `submit*`
+    admit requests (raising `admission.QueueFull` past capacity);
+    `shutdown()` drains in-flight work and joins every thread."""
+
+    def __init__(self, store_root: Optional[str] = None,
+                 name: str = "graftd",
+                 queue_capacity: Optional[int] = None,
+                 batch_wait: Optional[float] = None,
+                 max_batch_rows: Optional[int] = None,
+                 cache_capacity: Optional[int] = None,
+                 check_fn=None, host_fallback=None,
+                 autostart: bool = True):
+        self.name = name
+        self.store_root = Path(store_root) if store_root else None
+        self.queue = AdmissionQueue(queue_capacity,
+                                    on_prune=self._finalize_pruned)
+        self.cache = ResultCache(cache_capacity)
+        self.scheduler = BatchScheduler(
+            self.queue, check_fn=check_fn, host_fallback=host_fallback,
+            max_batch_rows=max_batch_rows, batch_wait=batch_wait)
+        self._requests: dict = {}
+        self._terminal: deque = deque()  # finished ids, oldest first
+        self._retain = retain_capacity()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._started = False
+        self._worker: Optional[threading.Thread] = None
+        self._inflight: list = []
+        self._latencies: deque = deque(maxlen=LATENCY_WINDOW)
+        self._stats = {
+            "submitted": 0, "completed": 0, "failed": 0, "cancelled": 0,
+            "rejected": 0, "cache_hits": 0, "batches": 0, "batch_rows": 0,
+            "batched_requests": 0, "degraded_batches": 0,
+            "max_queue_depth": 0, "worker_restarts": 0, "trace_errors": 0,
+        }
+        self._service_time_s = 1.0  # EWMA of per-request service time
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._stop.clear()
+        self.queue.reopen()
+        self._started = True
+        self._ensure_worker()
+
+    def _ensure_worker(self) -> None:
+        """Spawn (or respawn after death) the supervised worker. Called
+        under submit too, so a STARTED daemon whose worker died serves
+        the next tenant instead of silently queueing forever (a daemon
+        built with autostart=False stays parked until `start()` — the
+        deterministic-coalescing mode tests and the CI smoke use)."""
+        with self._lock:
+            if self._stop.is_set() or not self._started:
+                return
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._worker = threading.Thread(
+                target=self._supervised_loop, daemon=True,
+                name=f"{self.name}-worker")
+            self._worker.start()
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
+        """Stop the worker; queued requests are failed loudly (a
+        shutdown is not a verdict). Idempotent. The queue is CLOSED
+        before the drain, so a submission racing this call either
+        lands before the drain (and is failed by it) or gets
+        ServiceStopped from `put` — never a silently-stranded entry."""
+        self._stop.set()
+        self.queue.close()
+        worker = self._worker
+        if wait and worker is not None and worker.is_alive():
+            worker.join(timeout)
+        drained = self.queue.take(lambda pending: list(pending), timeout=0.0)
+        for r in drained:
+            r.finish(FAILED, error="service shut down before execution")
+            self._count("failed")
+            self._retire(r)
+
+    # --------------------------------------------------------- worker
+
+    def _supervised_loop(self) -> None:
+        try:
+            self._worker_loop()
+        except BaseException:
+            # The loop itself died (not a batch — _worker_loop contains
+            # per-batch error handling). Requeue what was popped and
+            # respawn: queued tenants must survive a worker bug.
+            LOG.exception("%s worker died; restarting", self.name)
+            with self._lock:
+                inflight, self._inflight = self._inflight, []
+            unfinished = [r for r in inflight
+                          if r.status in (QUEUED, RUNNING)]
+            for r in unfinished:
+                r.status = QUEUED
+            self.queue.requeue(unfinished)
+            self._count("worker_restarts")
+            if not self._stop.is_set():
+                with self._lock:
+                    self._worker = None
+                self._ensure_worker()
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.scheduler.next_batch(timeout=IDLE_POLL_S)
+            if not batch:
+                continue
+            with self._lock:
+                self._inflight = list(batch)
+            try:
+                info = self.scheduler.execute(batch)
+                self._account_batch(batch, info)
+            except Exception:
+                # Even the host fallback failed (or a scheduler bug):
+                # fail THIS batch's requests, keep serving the queue.
+                LOG.exception("%s batch execution failed", self.name)
+                for r in batch:
+                    if r.status not in (DONE, CANCELLED, FAILED):
+                        r.finish(FAILED, error="batch execution raised; "
+                                 "see service log")
+                self._account_requests(batch)
+            finally:
+                with self._lock:
+                    self._inflight = []
+            for r in batch:
+                self._write_trace(r)
+
+    # ------------------------------------------------------ admission
+
+    def submit(self, histories: Sequence, workload: str = "register",
+               algorithm: str = "auto", deadline_ms: Optional[float] = None,
+               priority: int = 0) -> CheckRequest:
+        """Admit a submission; returns its CheckRequest (already DONE on
+        a cache hit). Raises QueueFull with a retry-after estimate when
+        the queue is at capacity, ValueError on malformed input."""
+        req = admit(histories, workload, algorithm=algorithm,
+                    deadline_ms=deadline_ms, priority=priority)
+        return self._admit(req)
+
+    def submit_run_dir(self, run_dir, algorithm: str = "auto",
+                       deadline_ms: Optional[float] = None,
+                       priority: int = 0,
+                       workload: Optional[str] = None) -> CheckRequest:
+        """Admit a recorded-run directory (store/<name>/<ts>/)."""
+        req = admit_run_dir(run_dir, algorithm=algorithm,
+                            deadline_ms=deadline_ms, priority=priority,
+                            workload=workload)
+        return self._admit(req)
+
+    def _admit(self, req: CheckRequest) -> CheckRequest:
+        if self._stop.is_set():
+            # Fast-path refusal; the authoritative (race-free) check is
+            # the closed queue's own `put`, below.
+            raise ServiceStopped(f"{self.name} is shut down")
+        with self._lock:
+            self._requests[req.id] = req
+        cached = self.cache.get(req.fingerprint)
+        if cached is not None and len(cached) == req.n_rows:
+            req.cached = True
+            req.finish(DONE, results=cached)
+            self._count("submitted", "cache_hits", "completed")
+            self._observe_latency(req)
+            self._retire(req)
+            self._write_trace(req)
+            return req
+        try:
+            self.queue.put(req, retry_after_s=self._retry_after())
+        except QueueFull:
+            with self._lock:
+                self._stats["rejected"] += 1
+                del self._requests[req.id]
+            raise
+        except ServiceStopped:
+            with self._lock:
+                del self._requests[req.id]
+            raise
+        self._count("submitted")
+        with self._lock:
+            self._stats["max_queue_depth"] = max(
+                self._stats["max_queue_depth"], self.queue.depth)
+        self._ensure_worker()
+        return req
+
+    def _retry_after(self) -> float:
+        """Backpressure hint: pending work over observed service rate.
+        Never below half a second — a zero would invite a hot retry
+        loop from the very client the bound exists to absorb."""
+        with self._lock:
+            est = self._service_time_s
+        return round(max(0.5, self.queue.depth * est), 2)
+
+    # -------------------------------------------------------- queries
+
+    def get(self, request_id: str) -> Optional[CheckRequest]:
+        with self._lock:
+            return self._requests.get(request_id)
+
+    def cancel(self, request_id: str) -> Optional[str]:
+        """Cancel a request: pulled straight out if still queued,
+        honored at demux if already riding a launch. Returns the
+        request's status, or None for an unknown id."""
+        req = self.get(request_id)
+        if req is None:
+            return None
+        req.cancelled.set()
+        if self.queue.remove(req):
+            req.finish(CANCELLED)
+            self._count("cancelled")
+            self._retire(req)
+            self._write_trace(req)
+        return req.status
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            lat = list(self._latencies)
+        out["queue_depth"] = self.queue.depth
+        out["cache_entries"] = len(self.cache)
+        out["queue_capacity"] = self.queue.capacity
+        out["batch_occupancy_mean"] = round(
+            out["batched_requests"] / out["batches"], 3) \
+            if out["batches"] else 0.0
+        if lat:
+            lat.sort()
+            out["p50_latency_s"] = round(statistics.median(lat), 4)
+            out["p99_latency_s"] = round(
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4)
+        worker = self._worker
+        out["worker_alive"] = bool(worker is not None and worker.is_alive())
+        return out
+
+    # ----------------------------------------------------- accounting
+
+    def _count(self, *keys: str) -> None:
+        with self._lock:
+            for k in keys:
+                if k in self._stats:
+                    self._stats[k] += 1
+
+    def _retire(self, req: CheckRequest) -> None:
+        """Enter a terminal request into the bounded retention window;
+        the oldest finished requests (and their histories/encodings)
+        are dropped from the registry past JGRAFT_SERVICE_RETAIN —
+        in-flight requests are never evicted (only terminal ids enter
+        the window)."""
+        if getattr(req, "_retired", False):
+            return
+        req._retired = True
+        with self._lock:
+            self._terminal.append(req.id)
+            while len(self._terminal) > self._retain:
+                self._requests.pop(self._terminal.popleft(), None)
+
+    def _observe_latency(self, req: CheckRequest) -> None:
+        dt = time.monotonic() - req.submitted
+        with self._lock:
+            self._latencies.append(dt)
+            # EWMA feeds the retry-after estimate; per-REQUEST time.
+            self._service_time_s = 0.8 * self._service_time_s + 0.2 * dt
+
+    def _account_batch(self, batch, info: dict) -> None:
+        with self._lock:
+            self._stats["batches"] += 1
+            self._stats["batch_rows"] += info["rows"]
+            self._stats["batched_requests"] += info["requests"]
+            if info["degraded"]:
+                self._stats["degraded_batches"] += 1
+        self._account_requests(batch)
+
+    def _account_requests(self, batch) -> None:
+        for r in batch:
+            if r.status == DONE:
+                self._count("completed")
+                self._observe_latency(r)
+                if not r.stats.get("degraded") and not any(
+                        "platform-degraded" in res for res in r.results):
+                    # Cache only verdicts free of ANY degrade stamp —
+                    # including the process-registry stamp check_encoded
+                    # applies (a silently-pinned-CPU process), not just
+                    # this scheduler's local degrade path. A cached
+                    # stamp would replay onto a healed platform.
+                    self.cache.put(r.fingerprint, r.results)
+            elif r.status == CANCELLED:
+                self._count("cancelled")
+            elif r.status == FAILED:
+                self._count("failed")
+            if r.status in (DONE, CANCELLED, FAILED):
+                self._retire(r)
+
+    def _finalize_pruned(self, req: CheckRequest) -> None:
+        """Queue pruned a cancelled entry before it reached a batch."""
+        if req.status not in (DONE, CANCELLED, FAILED):
+            req.finish(CANCELLED)
+            self._count("cancelled")
+            self._retire(req)
+            self._write_trace(req)
+
+    # ---------------------------------------------------------- trace
+
+    def _write_trace(self, req: CheckRequest) -> None:
+        """Persist one request's terminal record into the store layout
+        (store/<service>/<ts>-<reqid>/: results.json + history.jsonl),
+        browsable by `core/serve.py` next to test runs. Best-effort:
+        trace IO must never fail a verdict (counted, logged)."""
+        if self.store_root is None or req.status == QUEUED:
+            return
+        try:
+            from ..core.store import _jsonable
+
+            ts = time.strftime("%Y%m%dT%H%M%S", time.localtime())
+            d = self.store_root / self.name / f"{ts}-{req.id}"
+            d.mkdir(parents=True, exist_ok=True)
+            payload = _jsonable(req.to_dict())
+            with open(d / "results.json", "w") as f:
+                json.dump(payload, f, indent=2)
+            with open(d / "history.jsonl", "w") as f:
+                for label, hist in req.units:
+                    for op in hist:
+                        row = dict(op.to_dict(), unit=label)
+                        f.write(json.dumps(_jsonable(row)) + "\n")
+        except OSError:
+            self._count("trace_errors")
+            LOG.warning("trace write failed for request %s", req.id,
+                        exc_info=True)

@@ -1,0 +1,205 @@
+"""graftd HTTP surface: stdlib http.server + JSON, no framework — the
+same stance as `core/serve.py` (the results browser this daemon's trace
+records feed).
+
+Endpoints::
+
+    POST /submit   {"workload": "register", "histories": [[op…]…],
+                    "algorithm"?, "deadline_ms"?, "priority"?,
+                    "run_dir"?}        → 200 {"id", "status", …}
+                                       → 429 {"error", "retry_after_s"}
+                                         (+ Retry-After header)
+                                       → 400 {"error"} on malformed input
+    GET  /result?id=ID[&wait_s=N]      → 200 request record (results
+                                         included once terminal)
+                                       → 404 unknown id
+    POST /cancel   {"id": ID}          → 200 {"id", "status"}
+    GET  /stats                        → 200 service counters
+    GET  /healthz                      → 200 {"ok": true, "worker_alive"}
+
+Run it: ``python -m jepsen_jgroups_raft_tpu serve-checker`` (cli.py) or
+embed via `make_server` (tests, the bench's --service mode).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from functools import partial
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .admission import QueueFull
+from .daemon import CheckingService, ServiceStopped
+
+#: Submission body size cap (bytes): 64 MiB of JSON ops is far beyond
+#: any legitimate history batch and bounds admission-side memory.
+MAX_BODY_BYTES = 64 << 20
+
+#: Cap on blocking result waits (seconds) so a handler thread can never
+#: be parked indefinitely by one client.
+MAX_WAIT_S = 60.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def __init__(self, *a, service: CheckingService, **kw):
+        self.service = service
+        super().__init__(*a, **kw)
+
+    # ------------------------------------------------------- plumbing
+
+    def _send(self, code: int, payload: dict,
+              extra_headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > MAX_BODY_BYTES:
+            raise ValueError(f"body too large ({length} bytes)")
+        raw = self.rfile.read(length) if length else b"{}"
+        payload = json.loads(raw or b"{}")
+        if not isinstance(payload, dict):
+            raise ValueError("request body must be a JSON object")
+        return payload
+
+    def _query(self) -> Tuple[str, dict]:
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(self.path)
+        return parsed.path, {k: v[-1]
+                             for k, v in parse_qs(parsed.query).items()}
+
+    def log_message(self, fmt, *args):
+        pass  # quiet, like core/serve.py
+
+    # ------------------------------------------------------- handlers
+
+    def do_GET(self):
+        path, q = self._query()
+        if path == "/healthz":
+            self._send(200, {"ok": True,
+                             "worker_alive":
+                             self.service.stats()["worker_alive"]})
+            return
+        if path == "/stats":
+            self._send(200, self.service.stats())
+            return
+        if path == "/result":
+            req = self.service.get(q.get("id", ""))
+            if req is None:
+                self._send(404, {"error": f"unknown request id "
+                                          f"{q.get('id', '')!r}"})
+                return
+            wait_s = q.get("wait_s")
+            if wait_s is not None:
+                try:
+                    req.wait(min(float(wait_s), MAX_WAIT_S))
+                except ValueError:
+                    self._send(400, {"error": f"bad wait_s {wait_s!r}"})
+                    return
+            self._send(200, req.to_dict())
+            return
+        self._send(404, {"error": f"no such endpoint {path!r}"})
+
+    def do_POST(self):
+        path, _ = self._query()
+        try:
+            body = self._body()
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad request body: {e}"})
+            return
+        if path == "/submit":
+            self._submit(body)
+            return
+        if path == "/cancel":
+            status = self.service.cancel(str(body.get("id", "")))
+            if status is None:
+                self._send(404, {"error": f"unknown request id "
+                                          f"{body.get('id')!r}"})
+            else:
+                self._send(200, {"id": body.get("id"), "status": status})
+            return
+        self._send(404, {"error": f"no such endpoint {path!r}"})
+
+    def _submit(self, body: dict) -> None:
+        try:
+            # Inside the try: a non-numeric priority/deadline is a 400,
+            # not an aborted connection.
+            kwargs = {"algorithm": str(body.get("algorithm", "auto")),
+                      "deadline_ms": body.get("deadline_ms"),
+                      "priority": int(body.get("priority", 0))}
+            if body.get("run_dir"):
+                req = self.service.submit_run_dir(
+                    str(body["run_dir"]), workload=body.get("workload"),
+                    **kwargs)
+            else:
+                req = self.service.submit(
+                    body.get("histories") or [],
+                    workload=str(body.get("workload", "register")),
+                    **kwargs)
+        except QueueFull as e:
+            self._send(429, {"error": str(e),
+                             "retry_after_s": e.retry_after_s},
+                       {"Retry-After": str(max(1, int(e.retry_after_s)))})
+            return
+        except ServiceStopped as e:
+            self._send(503, {"error": str(e)})
+            return
+        except (ValueError, OSError, KeyError, TypeError) as e:
+            # Malformed submissions (unknown workload, bad op rows,
+            # unreadable run dir) are client errors, not daemon faults.
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, req.to_dict(include_results=req.cached))
+
+
+def make_server(service: CheckingService, host: str = "127.0.0.1",
+                port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
+    """Bind the service's HTTP front (port 0 → ephemeral); the caller
+    owns `serve_forever` (thread it for tests/bench)."""
+    httpd = ThreadingHTTPServer((host, port),
+                                partial(_Handler, service=service))
+    return httpd, httpd.server_address[1]
+
+
+def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
+                  port: int = 8091,
+                  queue_capacity: Optional[int] = None,
+                  batch_wait: Optional[float] = None) -> int:
+    """CLI entry (`python -m jepsen_jgroups_raft_tpu serve-checker`):
+    run graftd in the foreground until interrupted."""
+    service = CheckingService(store_root=store_root,
+                              queue_capacity=queue_capacity,
+                              batch_wait=batch_wait)
+    httpd, bound = make_server(service, host, port)
+    print(f"graftd: checking service on http://{host}:{bound}/ "
+          f"(queue={service.queue.capacity}, store={store_root})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        service.shutdown(wait=True)
+    return 0
+
+
+def serve_in_thread(service: CheckingService, host: str = "127.0.0.1",
+                    port: int = 0):
+    """Start the HTTP front on a daemon thread; returns (httpd, port,
+    thread). Tests and the bench use this; shut down with
+    `httpd.shutdown(); httpd.server_close()`."""
+    httpd, bound = make_server(service, host, port)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="graftd-http")
+    t.start()
+    return httpd, bound, t

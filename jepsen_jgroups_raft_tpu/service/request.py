@@ -1,0 +1,254 @@
+"""Request records for the checking service.
+
+A submission enters graftd as raw history material (op dicts over the
+wire, `history.ops.History` objects in-process, or a recorded-run dir)
+and is normalized at ADMISSION into a `CheckRequest`: per-unit encoded
+event tensors (`history.packing.encode_history` — encoded exactly once,
+here), a content fingerprint over those tensors (the result-cache key:
+two tenants submitting byte-identical histories share one verdict), and
+scheduling metadata (deadline, priority, submit time). Everything
+downstream — bucketing, coalescing, demux — works on the encodings; the
+raw ops are kept only for the per-request trace record.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..checker.base import merge_valid
+from ..history.ops import History, Op
+from ..history.packing import EncodedHistory, encode_history
+
+# Request lifecycle states.
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+CANCELLED = "cancelled"
+FAILED = "failed"
+
+#: Priority clamp at admission: each unit is one second of deadline
+#: credit (scheduler.PRIORITY_CREDIT_S), so ±8 bounds the head start at
+#: ±8 s — well under the 30 s aging cap, keeping the documented
+#: starvation-free guarantee true against a client-supplied flood of
+#: arbitrarily large priorities.
+MAX_PRIORITY = 8
+
+#: workload name → (model factory, values are (key, value) tuples?).
+#: The tuple-valued workloads are split per key at admission (the same
+#: independent decomposition checker/recorded.py applies to stored
+#: runs), so one submitted multi-register history becomes one check
+#: unit per key. "register"/"counter" accept plain single-key histories
+#: — the shape tests and the bench submit.
+def service_workloads() -> dict:
+    from ..models import CasRegister, Counter
+
+    return {
+        "register": (CasRegister, False),
+        "counter": (Counter, False),
+        "single-register": (CasRegister, True),
+        "multi-register": (CasRegister, True),
+    }
+
+
+def history_from_dicts(rows: Sequence[dict]) -> History:
+    """Wire format → History: one op dict per row (`Op.to_dict` shape).
+    JSON has no tuples, so list-valued ops (the independent workloads'
+    (key, value) pairs) are retupled — same rule as `store.load_history`."""
+    h = History()
+    for d in rows:
+        d = dict(d)
+        if isinstance(d.get("value"), list):
+            d["value"] = tuple(d["value"])
+        h.append(Op.from_dict(d))
+    return h
+
+
+def fingerprint_encodings(model, algorithm: str,
+                          encs: Sequence[EncodedHistory]) -> str:
+    """Content hash over the packed arrays of a submission — the result
+    cache key. Hashing the ENCODING (not the op dicts) makes the cache
+    insensitive to wire-level noise that cannot change the verdict
+    (timestamps, op indices of dropped fail ops) while staying sound:
+    the encoded event stream is exactly the checker's input."""
+    h = hashlib.sha256()
+    h.update(type(model).__name__.encode())
+    h.update(b"\x00")
+    h.update(algorithm.encode())
+    for e in encs:
+        h.update(np.asarray(e.events.shape, dtype=np.int64).tobytes())
+        h.update(np.ascontiguousarray(e.events).tobytes())
+        h.update(np.int64(e.n_slots).tobytes())
+    return h.hexdigest()
+
+
+@dataclass
+class CheckRequest:
+    """One tenant submission, admitted and encoded.
+
+    units: (label, History) pairs — one frontier-check unit each (a
+        plain submission is one unit per history; independent workloads
+        contribute one unit per key).
+    encs: the per-unit encodings, parallel to `units`.
+    deadline/submitted: monotonic seconds (scheduling only — a missed
+        deadline reorders, it never drops).
+    results: per-unit checker result dicts once DONE.
+    stats: batch-attribution stamped at demux (batched_requests,
+        batch_rows, batch_seq, the launch's labeled scan-scope counters).
+    """
+
+    id: str
+    workload: str
+    model: object
+    algorithm: str
+    units: List[tuple]
+    encs: List[EncodedHistory]
+    fingerprint: str
+    deadline: float
+    submitted: float
+    priority: int = 0
+    status: str = QUEUED
+    results: Optional[List[dict]] = None
+    error: Optional[str] = None
+    cached: bool = False
+    stats: dict = field(default_factory=dict)
+    cancelled: threading.Event = field(default_factory=threading.Event)
+    _done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.encs)
+
+    def verdict(self):
+        """Merged validity over the request's units (checker.base rule:
+        any INVALID → INVALID, else any non-VALID → UNKNOWN)."""
+        if self.results is None:
+            return None
+        return merge_valid(r.get("valid?") for r in self.results)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request reaches a terminal state."""
+        return self._done.wait(timeout)
+
+    def finish(self, status: str, results: Optional[List[dict]] = None,
+               error: Optional[str] = None) -> None:
+        # Results/error land BEFORE the terminal status: a concurrent
+        # reader polling `status` (the HTTP surface's to_dict without
+        # wait_s) must never observe a terminal state whose results are
+        # still missing.
+        self.results = results
+        self.error = error
+        self.status = status
+        self._done.set()
+
+    def to_dict(self, include_results: bool = True) -> dict:
+        d = {
+            "id": self.id,
+            "status": self.status,
+            "workload": self.workload,
+            "algorithm": self.algorithm,
+            "units": [label for label, _ in self.units],
+            "fingerprint": self.fingerprint,
+            "priority": self.priority,
+            "cached": self.cached,
+        }
+        if self.error is not None:
+            d["error"] = self.error
+        if self.stats:
+            d["service-stats"] = dict(self.stats)
+        if include_results and self.results is not None:
+            d["valid?"] = self.verdict()
+            d["results"] = self.results
+        return d
+
+
+def admit(histories: Sequence, workload: str, algorithm: str = "auto",
+          deadline_ms: Optional[float] = None, priority: int = 0,
+          default_deadline_s: float = 3600.0,
+          request_id: Optional[str] = None) -> CheckRequest:
+    """Normalize a submission into a CheckRequest (encode once +
+    fingerprint). `histories` items are History objects or op-dict
+    lists. Raises ValueError on unknown workloads / malformed ops — the
+    HTTP surface maps that to 400, never into the queue."""
+    workloads = service_workloads()
+    if workload not in workloads:
+        raise ValueError(f"unknown workload {workload!r} "
+                         f"(have: {', '.join(sorted(workloads))})")
+    model_factory, independent = workloads[workload]
+    model = model_factory()
+    units: List[tuple] = []
+    for i, h in enumerate(histories):
+        if not isinstance(h, History):
+            h = history_from_dicts(h)
+        h = h.client_ops()
+        if independent:
+            from ..checker.independent import split_by_key
+
+            for key, sub in sorted(split_by_key(h).items(),
+                                   key=lambda kv: str(kv[0])):
+                units.append((f"h{i}/key={key}", sub))
+        else:
+            units.append((f"h{i}", h))
+    if not units:
+        raise ValueError("empty submission: no checkable history units")
+    encs = [encode_history(h, model) for _, h in units]
+    now = time.monotonic()
+    deadline = now + (deadline_ms / 1000.0 if deadline_ms is not None
+                      else default_deadline_s)
+    return CheckRequest(
+        id=request_id or uuid.uuid4().hex[:12],
+        workload=workload,
+        model=model,
+        algorithm=algorithm,
+        units=units,
+        encs=encs,
+        fingerprint=fingerprint_encodings(model, algorithm, encs),
+        deadline=deadline,
+        submitted=now,
+        priority=clamp_priority(priority),
+    )
+
+
+def clamp_priority(priority) -> int:
+    return max(-MAX_PRIORITY, min(MAX_PRIORITY, int(priority)))
+
+
+def admit_run_dir(run_dir, algorithm: str = "auto",
+                  deadline_ms: Optional[float] = None, priority: int = 0,
+                  workload: Optional[str] = None,
+                  default_deadline_s: float = 3600.0) -> CheckRequest:
+    """Admit a recorded-run directory (store/<name>/<ts>/): load the
+    stored history, split per key exactly like `checker/recorded.py`,
+    and check it as one request. The service's re-verification surface
+    for artifacts a live run already produced."""
+    from ..checker.recorded import load_run_histories
+    from ..models.base import Model
+
+    model, subs, wl = load_run_histories(run_dir, workload)
+    if not isinstance(model, Model):
+        raise ValueError(
+            f"{run_dir}: workload {wl!r} uses a non-frontier checker; "
+            "re-verify it with `python -m jepsen_jgroups_raft_tpu check`")
+    units = [(f"{wl}/u{i}", h) for i, h in enumerate(subs)]
+    encs = [encode_history(h, model) for _, h in units]
+    now = time.monotonic()
+    deadline = now + (deadline_ms / 1000.0 if deadline_ms is not None
+                      else default_deadline_s)
+    return CheckRequest(
+        id=uuid.uuid4().hex[:12],
+        workload=wl,
+        model=model,
+        algorithm=algorithm,
+        units=units,
+        encs=encs,
+        fingerprint=fingerprint_encodings(model, algorithm, encs),
+        deadline=deadline,
+        submitted=now,
+        priority=clamp_priority(priority),
+    )

@@ -1,0 +1,258 @@
+"""Batching scheduler: cross-request coalescing over the chunked scan.
+
+The substrate PR 3/4 built — chunked `ChunkLaunch` dispatch with
+decided-row eviction, pow2+midpoint shape buckets, macro-event
+compaction — amortizes kernel work across the ROWS of one caller's
+batch. This module extends the amortization across CALLERS: pending
+requests whose encodings pack into the same shape bucket are coalesced
+into one `check_encoded` batch, so many small tenant histories ride a
+single dense/sort/Pallas launch; per-request verdicts are demuxed back
+by row count after the wavefront evicts them.
+
+Soundness of the coalescing (doc/checker-design.md §8): every kernel
+family treats the batch axis as fully independent — rows never exchange
+state (frontier carries are per-row, eviction/recompaction is a gather
+over rows, window grouping only re-orders rows between launches) — so
+the verdict of a row is a function of that row's event stream alone,
+and a demuxed verdict is bitwise-identical to the verdict of the same
+history checked in isolation (pinned by tests/test_service.py
+differentials).
+
+Ordering: requests are served by EFFECTIVE deadline
+``min(deadline, submitted + aging_cap) - priority_credit·priority`` —
+the deadline drives urgency, the aging cap bounds how long a
+far-deadline request can be overtaken (starvation-free: after
+`AGING_CAP_S` of waiting, a request's key stops growing and arrival
+time breaks ties), and priority buys a fixed head start rather than a
+strict class (a priority flood cannot starve the plain tier forever).
+A batch is formed from the head request's shape bucket; when it is
+small and the head deadline is not imminent, the scheduler lingers
+``JGRAFT_SERVICE_BATCH_WAIT_MS`` for more same-bucket arrivals — the
+classic batching-window trade (latency of the head vs occupancy of the
+launch).
+
+Resilience: the device path failing MID-CHECK (tunnel drop, injected
+fault) degrades the batch to the host-only ladder
+(`checker.linearizable.check_encoded_host` — CPU frontier, budgeted
+DFS), stamping ``platform-degraded`` into every affected result and
+recording the root cause via `platform.note_degraded`; the request
+completes with a sound verdict instead of erroring.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..checker.schedule import stats_scope
+from ..history.packing import bucket_rows
+from ..platform import env_int, is_backend_init_failure, note_degraded
+from .admission import AdmissionQueue
+from .request import CANCELLED, DONE, FAILED, RUNNING, CheckRequest
+
+LOG = logging.getLogger("jgraft.service")
+
+#: Default linger for batch formation (ms). Small against check time,
+#: large against localhost submit bursts: concurrent tenants submitting
+#: within one RPC round trip coalesce, a lone request pays ≤ this.
+DEFAULT_BATCH_WAIT_MS = 50
+
+#: A request waiting this long is as urgent as scheduling ever treats
+#: it (its effective deadline stops receding) — the starvation bound.
+AGING_CAP_S = 30.0
+
+#: Seconds of deadline credit per priority unit.
+PRIORITY_CREDIT_S = 1.0
+
+#: Cap on rows (check units) per coalesced launch batch.
+DEFAULT_MAX_BATCH_ROWS = 256
+
+
+def batch_wait_s() -> float:
+    """Resolved linger window (JGRAFT_SERVICE_BATCH_WAIT_MS; defensive
+    parse — garbage warns and keeps the default)."""
+    return env_int("JGRAFT_SERVICE_BATCH_WAIT_MS", DEFAULT_BATCH_WAIT_MS,
+                   minimum=0) / 1000.0
+
+
+def effective_deadline(req: CheckRequest,
+                       aging_cap_s: float = AGING_CAP_S) -> float:
+    """Scheduling key (smaller = sooner). See module docstring."""
+    return (min(req.deadline, req.submitted + aging_cap_s)
+            - PRIORITY_CREDIT_S * req.priority)
+
+
+def bucket_signature(req: CheckRequest) -> tuple:
+    """Shape bucket a request's rows pack into — the coalescing key.
+
+    Two requests with the same signature ride one `check_encoded` batch
+    whose group packing pads them into shared jit-cache shapes: same
+    model family (one kernel family), same algorithm, and the same
+    pow2+midpoint EVENT bucket (`bucket_rows(E, 32)` — the floor_e=32
+    series `pad_batch_bucketed` pads short groups to). Window grouping
+    inside the checker re-buckets rows further by concurrency window;
+    that is invisible here because it happens after concatenation."""
+    e_max = max((e.n_events for e in req.encs), default=0)
+    return (type(req.model).__name__, req.algorithm,
+            bucket_rows(max(e_max, 1), 32))
+
+
+class BatchScheduler:
+    """Forms and executes coalesced batches from an AdmissionQueue."""
+
+    def __init__(self, queue: AdmissionQueue,
+                 check_fn: Optional[Callable] = None,
+                 host_fallback: Optional[Callable] = None,
+                 max_batch_rows: Optional[int] = None,
+                 batch_wait: Optional[float] = None,
+                 aging_cap_s: float = AGING_CAP_S):
+        from ..checker.linearizable import check_encoded, check_encoded_host
+
+        #: device-path seam (tests inject failures / gates here).
+        self.check_fn = check_fn or check_encoded
+        self.host_fallback = host_fallback or check_encoded_host
+        self.max_batch_rows = (max_batch_rows if max_batch_rows is not None
+                               else env_int("JGRAFT_SERVICE_MAX_BATCH_ROWS",
+                                            DEFAULT_MAX_BATCH_ROWS,
+                                            minimum=1))
+        self.batch_wait = (batch_wait if batch_wait is not None
+                           else batch_wait_s())
+        self.aging_cap_s = aging_cap_s
+        self.queue = queue
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    # ------------------------------------------------------ formation
+
+    def _choose(self, pending: List[CheckRequest]) -> List[CheckRequest]:
+        """Head request by effective deadline, plus every same-bucket
+        request that fits the row cap, in deadline order."""
+        ordered = sorted(pending, key=lambda r: (
+            effective_deadline(r, self.aging_cap_s), r.submitted))
+        head = ordered[0]
+        sig = bucket_signature(head)
+        batch, rows = [], 0
+        for r in ordered:
+            if bucket_signature(r) != sig:
+                continue
+            if batch and rows + r.n_rows > self.max_batch_rows:
+                break
+            batch.append(r)
+            rows += r.n_rows
+        return batch
+
+    def next_batch(self, timeout: float) -> List[CheckRequest]:
+        """Block up to `timeout` for a batch. After the first pick, if
+        the launch is far from full and the head's deadline allows,
+        linger one batch-wait window and sweep in same-bucket arrivals
+        (deadline order is preserved: the linger only ever ADDS rows to
+        the head's launch, it never reorders across buckets)."""
+        batch = self.queue.take(self._choose, timeout)
+        if not batch:
+            return batch
+        head = batch[0]
+        rows = sum(r.n_rows for r in batch)
+        slack = head.deadline - time.monotonic()
+        if (self.batch_wait > 0 and rows < self.max_batch_rows
+                and slack > self.batch_wait):
+            time.sleep(self.batch_wait)
+            sig = bucket_signature(head)
+
+            def topup(pending: List[CheckRequest]) -> List[CheckRequest]:
+                extra, extra_rows = [], rows
+                for r in sorted(pending, key=lambda r: (
+                        effective_deadline(r, self.aging_cap_s),
+                        r.submitted)):
+                    if bucket_signature(r) != sig:
+                        continue
+                    if extra_rows + r.n_rows > self.max_batch_rows:
+                        break
+                    extra.append(r)
+                    extra_rows += r.n_rows
+                return extra
+
+            batch.extend(self.queue.take(topup, timeout=0.0))
+        # Requests cancelled between pop and here stay in the batch:
+        # execute() finalizes them as CANCELLED (dropping them silently
+        # would leave their waiters blocked forever).
+        return batch
+
+    # ------------------------------------------------------ execution
+
+    def execute(self, batch: List[CheckRequest]) -> dict:
+        """Run one coalesced batch and demux; returns batch-level stats
+        for the daemon's counters. Cancelled requests are finalized
+        without results (a cancel landing mid-chunk is honored at
+        demux: the row work is already spent, the verdict is simply
+        not delivered)."""
+        live = []
+        for r in batch:
+            if r.cancelled.is_set():
+                r.finish(CANCELLED)
+            else:
+                r.status = RUNNING
+                live.append(r)
+        if not live:
+            return {"requests": 0, "rows": 0, "degraded": False,
+                    "wall_s": 0.0}
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        encs = [e for r in live for e in r.encs]
+        model = live[0].model
+        algorithm = live[0].algorithm
+        label = "graftd:" + ",".join(r.id for r in live)
+        degraded_note_local = None
+        t0 = time.monotonic()
+        with stats_scope(label=label) as scan:
+            try:
+                results = self.check_fn(encs, model, algorithm=algorithm)
+            except Exception as e:
+                # Device path died mid-check (tunnel drop, backend
+                # teardown, injected fault): degrade THIS batch to the
+                # host-only ladder — a slower sound verdict beats a
+                # failed request. The stamp is LOCAL to this batch's
+                # results; the process-wide first-note-wins registry is
+                # only written for platform-level failures (backend
+                # init / tunnel-drop flavors), where "this process is
+                # degraded" is genuinely true of later batches too — a
+                # one-off non-platform error must not poison every
+                # healthy verdict a long-lived daemon produces after it
+                # (check_encoded stamps all results whenever the
+                # registry holds a note).
+                LOG.warning("graftd batch seq=%d device path failed; "
+                            "degrading %d rows to host CPU",
+                            seq, len(encs), exc_info=True)
+                degraded_note_local = (
+                    f"graftd degraded to host CPU mid-check: "
+                    f"{type(e).__name__}: {e}"[:300])
+                if is_backend_init_failure(e):
+                    note_degraded(degraded_note_local)
+                results = [self.host_fallback(enc, model) for enc in encs]
+                for res in results:
+                    res["platform-degraded"] = degraded_note_local
+        wall = time.monotonic() - t0
+        scan_counters = {k: v for k, v in scan.items() if k != "label"}
+        cursor = 0
+        for r in live:
+            mine = results[cursor:cursor + r.n_rows]
+            cursor += r.n_rows
+            r.stats = {
+                "batched_requests": len(live),
+                "batch_rows": len(encs),
+                "batch_seq": seq,
+                "batch_wall_s": round(wall, 4),
+                "scan": dict(scan_counters, label=label),
+                "degraded": degraded_note_local is not None,
+            }
+            if r.cancelled.is_set():
+                r.finish(CANCELLED)
+            elif any(res is None for res in mine):
+                r.finish(FAILED, error="checker returned no verdict")
+            else:
+                r.finish(DONE, results=mine)
+        return {"requests": len(live), "rows": len(encs),
+                "degraded": degraded_note_local is not None,
+                "wall_s": wall, "seq": seq}

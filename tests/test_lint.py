@@ -54,6 +54,29 @@ class TestSelfHosting:
     def test_unknown_analyzer_is_usage_error(self):
         assert cli.main(["--rules", "nonsense"]) == 2
 
+    def test_taxonomy_scope_covers_service_tier(self):
+        # ISSUE-5 satellite: the narrowed-except discipline extends to
+        # the results browser and the whole checking-service package.
+        for rel in ("core/serve.py", "service/daemon.py",
+                    "service/http.py", "service/client.py"):
+            assert taxonomy.applies_to(
+                f"jepsen_jgroups_raft_tpu/{rel}"), rel
+
+    def test_serve_verdict_broad_except_would_fire(self):
+        # the pre-fix _verdict shape (bare `except Exception: return
+        # None`) is exactly a silent swallow; the fixed narrow catch
+        # stays quiet — proves the new scope is not vacuous.
+        bad = ("def _verdict(run):\n"
+               "    try:\n"
+               "        with open(run / 'results.json') as f:\n"
+               "            return json.load(f).get('valid?')\n"
+               "    except Exception:\n"
+               "        return None\n")
+        assert "taxonomy-silent-swallow" in rules_of(tax(bad))
+        good = bad.replace("except Exception:",
+                           "except (OSError, json.JSONDecodeError):")
+        assert tax(good) == []
+
     def test_native_headers_carry_annotations(self):
         # the lock pass must not be vacuous: the production headers
         # declare guarded state

@@ -559,6 +559,84 @@ class TestResourceLeak:
         assert rl(snippet) == []
 
 
+class TestServiceResourceScope:
+    """ISSUE-5 satellite: the analyzer's scan set covers the service
+    tier (graftd holds queue entries, per-call client sockets, trace
+    file handles, and worker threads across exception paths — and it is
+    long-lived, so a per-request leak exhausts the daemon's fds where a
+    one-shot run never notices). Scope + shipped-clean + the mutation
+    proving the analyzer FIRES on the real service source."""
+
+    SERVICE_FILES = ("service/request.py", "service/admission.py",
+                     "service/scheduler.py", "service/daemon.py",
+                     "service/http.py", "service/client.py")
+
+    def test_scope_covers_service_package(self):
+        for f in self.SERVICE_FILES:
+            assert resource.applies_to(f"jepsen_jgroups_raft_tpu/{f}"), f
+        assert not resource.applies_to(
+            "jepsen_jgroups_raft_tpu/checker/linearizable.py")
+
+    def test_service_tier_clean(self):
+        for f in self.SERVICE_FILES:
+            src = SourceFile.load(PKG / Path(f))
+            assert resource.analyze_source(src) == [], f
+
+    def test_trace_handle_mutation_fires(self):
+        # daemon._write_trace holds the results.json handle in a
+        # `with`; demoting it to a bare open() must re-arm the analyzer
+        # on the REAL source (the exception edge out of json.dump then
+        # escapes without a close).
+        text = (PKG / "service" / "daemon.py").read_text()
+        managed = ('with open(d / "results.json", "w") as f:\n'
+                   '                json.dump(payload, f, indent=2)')
+        assert managed in text  # the mutation target must exist
+        mutated = text.replace(
+            managed,
+            'f = open(d / "results.json", "w")\n'
+            '            json.dump(payload, f, indent=2)')
+        assert mutated != text
+        found = resource.analyze_source(
+            SourceFile.from_text("daemon.py", mutated))
+        assert any(f.rule == "flow-resource-leak" and "`f`" in f.message
+                   for f in found)
+
+    def test_submit_socket_leak_shape(self):
+        # the client-socket-per-submission shape: a raising request()
+        # path escapes with the socket open
+        bad = ("def push(netloc, payload):\n"
+               "    sock = create_connection(netloc)\n"
+               "    sock.sendall(payload)\n"
+               "    sock.close()\n")
+        [f] = rl(bad)
+        assert f.rule == "flow-resource-leak" and f.line == 2
+        good = ("def push(netloc, payload):\n"
+                "    sock = create_connection(netloc)\n"
+                "    try:\n"
+                "        sock.sendall(payload)\n"
+                "    finally:\n"
+                "        sock.close()\n")
+        assert rl(good) == []
+
+    def test_queue_entry_trace_handle_shape(self):
+        # queue-entry bookkeeping that opens a per-request trace file
+        # and loses it when the write raises mid-loop
+        bad = ("def drain(entries, root):\n"
+               "    for e in entries:\n"
+               "        trace = open(root / e.id, 'w')\n"
+               "        trace.write(e.payload)\n"
+               "        trace.close()\n")
+        [f] = rl(bad)
+        assert f.rule == "flow-resource-leak"
+        good = bad.replace(
+            "        trace = open(root / e.id, 'w')\n"
+            "        trace.write(e.payload)\n"
+            "        trace.close()\n",
+            "        with open(root / e.id, 'w') as trace:\n"
+            "            trace.write(e.payload)\n")
+        assert rl(good) == []
+
+
 # ------------------------------------------------------- CLI + baseline
 
 
