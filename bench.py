@@ -48,13 +48,29 @@ RETRY_SLEEP_S = float(os.environ.get("JGRAFT_BENCH_PROBE_RETRY_S", "60"))
 RETRY_WINDOW_S = float(os.environ.get("JGRAFT_BENCH_PROBE_WINDOW_S", "600"))
 
 
-def probe_platform(keep_env_pin: bool) -> str | None:
-    """Return the jax platform, probed in a subprocess so a hung backend
-    init (unreachable TPU tunnel) cannot hang the benchmark. With
-    `keep_env_pin` the subprocess inherits JAX_PLATFORMS as-is (probing
-    exactly the backend the main process would init); otherwise the pin
-    is stripped and the default backend answers."""
-    code = "import jax; print(jax.devices()[0].platform)"
+#: Probe failure diagnostics for the CURRENT process, stamped into every
+#: bench JSON row as `probe_error` (ISSUE-6 satellite: the r01–r05
+#: rounds each degraded with NOTHING in the artifact saying why — the
+#: exception class/message died in the probe subprocess). None when the
+#: probe answered cleanly.
+_PROBE_ERROR: dict | None = None
+
+
+def probe_platform(keep_env_pin: bool) -> tuple[str | None, dict | None]:
+    """Return (platform, error): the jax platform probed in a subprocess
+    so a hung backend init (unreachable TPU tunnel) cannot hang the
+    benchmark, plus structured diagnostics (exception class + message /
+    exit status + stderr tail) when the probe fails. With `keep_env_pin`
+    the subprocess inherits JAX_PLATFORMS as-is (probing exactly the
+    backend the main process would init); otherwise the pin is stripped
+    and the default backend answers."""
+    code = ("import traceback\n"
+            "try:\n"
+            "    import jax; print(jax.devices()[0].platform)\n"
+            "except BaseException as e:\n"
+            "    print('PROBE_EXC %s: %s'\n"
+            "          % (type(e).__name__, str(e)[:200]), flush=True)\n"
+            "    raise\n")
     env = dict(os.environ)
     if not keep_env_pin:
         env.pop("JAX_PLATFORMS", None)
@@ -64,28 +80,104 @@ def probe_platform(keep_env_pin: bool) -> str | None:
             timeout=PROBE_TIMEOUT_S, env=env,
         )
     except subprocess.TimeoutExpired:
-        return None
+        return None, {"kind": "TimeoutExpired",
+                      "detail": f"probe exceeded {PROBE_TIMEOUT_S:.0f}s "
+                      "(hung backend init — wedged TPU tunnel)"}
     if out.returncode != 0:
-        return None
+        exc = [ln for ln in out.stdout.splitlines()
+               if ln.startswith("PROBE_EXC ")]
+        detail = (exc[-1][len("PROBE_EXC "):] if exc
+                  else (out.stderr.strip().splitlines() or ["<no stderr>"]
+                        )[-1][:300])
+        return None, {"kind": "ProbeExit", "returncode": out.returncode,
+                      "detail": detail}
     platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-    return platform or None
+    if not platform:
+        return None, {"kind": "EmptyAnswer",
+                      "detail": "probe exited 0 with no platform printed"}
+    return platform, None
 
 
 def probe_with_retry(keep_env_pin: bool) -> tuple[str | None, int]:
     """Probe, retrying over RETRY_WINDOW_S while the probe hangs or errors
     (a *wedged* tunnel). A clean "cpu" answer is final — that means no TPU
-    is plugged, not that the tunnel is flaky. Returns (platform, attempts)."""
+    is plugged, not that the tunnel is flaky. Returns (platform, attempts);
+    the LAST failure's diagnostics land in `_PROBE_ERROR` (with the
+    attempt count) for the bench JSON."""
+    global _PROBE_ERROR
     deadline = time.monotonic() + RETRY_WINDOW_S
     attempts = 0
     while True:
         attempts += 1
-        platform = probe_platform(keep_env_pin)
+        platform, err = probe_platform(keep_env_pin)
+        if err is not None:
+            _PROBE_ERROR = dict(err, attempts=attempts)
+        elif platform is not None:
+            _PROBE_ERROR = None
         if platform is not None or time.monotonic() >= deadline:
             return platform, attempts
         time.sleep(min(RETRY_SLEEP_S, max(0.0, deadline - time.monotonic())))
 
 
 from jepsen_jgroups_raft_tpu.platform import pin_cpu  # noqa: E402
+
+
+def allow_degraded() -> bool:
+    """Whether a degraded (target ≠ actual platform) run may proceed and
+    emit numbers: the --allow-degraded flag or its env twin (for
+    drivers that cannot edit argv)."""
+    return ("--allow-degraded" in sys.argv
+            or os.environ.get("JGRAFT_BENCH_ALLOW_DEGRADED") == "1")
+
+
+def target_platform() -> str:
+    """The platform this bench run is FOR: the original target carried
+    across a degrade re-exec first (JGRAFT_BENCH_TARGET — the exec
+    boundary must not launder what the operator asked for), then an
+    explicit override, the env pin's first entry, else the north-star
+    target (tpu) — the same "tpu" every row's target_platform field has
+    always declared."""
+    t = (os.environ.get("JGRAFT_BENCH_TARGET")
+         or os.environ.get("JGRAFT_BENCH_PLATFORM"))
+    if t:
+        return t
+    pin = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    return pin or "tpu"
+
+
+def enforce_platform(note: str, target: str | None = None) -> None:
+    """ISSUE-6 satellite: end the r01–r05 "silent CPU" pattern. When the
+    run is degraded — the intended platform is an accelerator but the
+    process is on the host (probe failure, init-failure re-exec, env
+    mismatch) — refuse to emit a number unless --allow-degraded /
+    JGRAFT_BENCH_ALLOW_DEGRADED=1 says the operator wants the host
+    measurement anyway. The refusal row carries the probe diagnostics,
+    so the artifact finally says WHY the accelerator was unreachable."""
+    import jax
+
+    from jepsen_jgroups_raft_tpu.platform import degraded_note
+
+    target = target or target_platform()
+    actual = jax.devices()[0].platform
+    # The degrade that matters is accelerator-wanted/host-got: exact
+    # plugin spellings (axon vs tpu) must not trip the gate.
+    degraded = ((actual == "cpu") != (target == "cpu")
+                or degraded_note() is not None
+                or bool(os.environ.get("JGRAFT_BENCH_DEGRADED")))
+    if not degraded or allow_degraded():
+        return
+    fail(f"platform degraded: target={target} actual={actual} — "
+         "refusing to emit a degraded number (pass --allow-degraded or "
+         "JGRAFT_BENCH_ALLOW_DEGRADED=1 to measure the host anyway, or "
+         "JGRAFT_BENCH_PLATFORM=cpu to measure it on purpose)",
+         target_platform=target, platform=actual,
+         probe_error=_PROBE_ERROR,
+         # the re-exec path never re-probes, so the original in-process
+         # failure (carried through the exec env) is the diagnostics
+         degraded_reason=os.environ.get("JGRAFT_BENCH_DEGRADED"),
+         platform_note=note)
+    persist_artifact("degraded_refused")
+    sys.exit(2)
 
 
 _EMITTED: list[dict] = []  # everything printed, for artifact persistence
@@ -404,12 +496,23 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         from jepsen_jgroups_raft_tpu.checker.linearizable import (
             _route_group_to_host)
 
+        from jepsen_jgroups_raft_tpu.checker import autotune
+
         consume_stats()  # this rep's counters only
         t0 = time.perf_counter()
-        triples = [(idxs, plan, _group_pack([encs[i] for i in idxs]))
-                   for idxs, plan in grouped]
+        # Same per-group autotune consult as the checker's production
+        # path (checker/linearizable._jax_pass): the bench must measure
+        # the schedule the checker routes. The first (untimed warm-up)
+        # run pays any plan measurement; timed reps load from memory.
+        triples = []
+        for idxs, plan in grouped:
+            sub_encs = [encs[i] for i in idxs]
+            tuned = autotune.tuned_group_plan(model, plan, sub_encs)
+            batch = (autotune.pack_group(sub_encs, tuned)
+                     if tuned is not None else _group_pack(sub_encs))
+            triples.append((idxs, plan, batch, tuned))
         t1 = time.perf_counter()
-        scan_steps = sum(int(b["n_events"].sum()) for _, _, b in triples)
+        scan_steps = sum(int(b["n_events"].sum()) for _, _, b, _t in triples)
         launches, _ = build_dense_launches(
             model, triples, host_route=_route_group_to_host)
         outs = run_chunked(launches)
@@ -516,10 +619,34 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "rep_times_s": [round(t, 3) for t in rep_times],
         **cold_warm(rep_times),
         "host_fingerprint": host_fingerprint(),
+        # ISSUE-6: why the probe failed (None on a clean probe), and
+        # which per-bucket autotuned plans drove the launches.
+        "probe_error": _PROBE_ERROR,
+        "autotune_plan": autotune_report(),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
         "platform_note": platform_note,
     })
+
+
+def autotune_report() -> dict:
+    """Bench-JSON summary of the autotuner's engagement this process:
+    enabled flag, process counters (the CI autotune→re-run cycle
+    asserts `loaded > 0` on the second run — the persisted plan was
+    actually consulted, not re-measured), and the applied plans deduped
+    by bucket signature."""
+    from jepsen_jgroups_raft_tpu.checker import autotune
+
+    counters = autotune.snapshot_counters()
+    plans: dict = {}
+    for entry in autotune.applied_log():
+        plans["/".join(str(x) for x in entry["signature"])] = {
+            "plan": entry["plan"], "source": entry["source"]}
+    return {"enabled": autotune.autotune_on(),
+            "loaded": counters["plans_loaded"],
+            "measured": counters["plans_measured"],
+            "misses": counters["plan_misses"],
+            "plans": plans}
 
 
 def run_suite(platform_note: str) -> None:
@@ -540,6 +667,7 @@ def run_suite(platform_note: str) -> None:
 
     platform = jax.devices()[0].platform
     emit({"suite_platform": platform, "note": platform_note,
+          "probe_error": _PROBE_ERROR,
           "host_fingerprint": host_fingerprint()})
     # JGRAFT_SUITE_SCALE in (0,1] shrinks every config proportionally —
     # smoke-testing the suite plumbing without the full-size wall clock.
@@ -782,6 +910,8 @@ def run_service(platform_note: str) -> None:
         "rep_times_s": [round(t, 3) for t in rep_times],
         **cold_warm(rep_times),
         "host_fingerprint": host_fingerprint(),
+        "probe_error": _PROBE_ERROR,
+        "autotune_plan": autotune_report(),
         "devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
         "platform_note": platform_note,
@@ -885,16 +1015,23 @@ def resolve_platform() -> str:
 
 
 def main() -> None:
+    # The intended platform is what the operator asked for BEFORE
+    # resolution — resolve_platform's degrade path pins the env to cpu,
+    # which must not launder the target the gate compares against.
+    target = target_platform()
     note = resolve_platform()
     beat()
-    _start_watchdog()
     if degraded := os.environ.get("JGRAFT_BENCH_DEGRADED"):
+        # Fold the re-exec'd run's original failure into the note
+        # BEFORE the gate, so a refusal row carries the real reason.
         note += f" [degraded: first attempt failed: {degraded}]"
         # The re-exec'd CPU run is a degraded run: stamp checker-side
         # results too (same registry resolve_platform's probe path uses).
         from jepsen_jgroups_raft_tpu.platform import note_degraded
 
         note_degraded(f"re-exec on cpu after backend failure: {degraded}")
+    enforce_platform(note, target=target)
+    _start_watchdog()
     if "--suite" in sys.argv:
         run_suite(note)
         persist_artifact("suite")
@@ -936,6 +1073,10 @@ def _reexec_on_cpu(e: BaseException) -> None:
     _run_cleanups()
     env = cpu_subprocess_env()
     env["JGRAFT_BENCH_PLATFORM"] = "cpu"
+    # Carry the ORIGINAL target across the exec: the re-exec'd process
+    # must report target=<what the operator asked for>, not the cpu pin
+    # this escape hatch sets (enforce_platform compares against it).
+    env["JGRAFT_BENCH_TARGET"] = target_platform()
     env["JGRAFT_BENCH_DEGRADED"] = f"{type(e).__name__}: {e}"[:300]
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 

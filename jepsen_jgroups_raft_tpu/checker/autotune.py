@@ -1,0 +1,613 @@
+"""Per-shape-bucket autotuner: measured, fingerprint-keyed plan selection.
+
+The kernel IR (ops/kernel_ir.py) made every execution knob uniform
+across kernel families; this module is what that uniformity unlocks —
+the PR 6 tentpole's second half. Every tuning knob used to be a global
+default (`JGRAFT_SCAN_CHUNK`, the macro payload cap, the
+`JGRAFT_GROUP_DEVICES` fan-out) even though the right value is a
+per-shape decision: a 16-row window group drowns in 8-way shard_map
+rendezvous that a 1000-row group amortizes, and a short-event group
+pays chunk-boundary flag syncs that buy it nothing. The autotuner picks
+``{family, scan_chunk, macro_payload_cap, mesh_fanout}`` per SHAPE
+BUCKET from short measured in-process samples, and persists the winning
+plan so later processes load instead of re-measure.
+
+Measurement discipline (the repo's hard-won rule — BENCH_r05 → PR 3
+drift notes): cross-process numbers measure the host's mood, not the
+machine, so every candidate is sampled IN-PROCESS, interleaved
+(candidate order rotates inside each rep like scripts/ab_macro.py), on
+a row-sample of the actual batch, with one untimed warm-up rep
+absorbing XLA compiles. Sample runs go through the very launch path the
+plan will drive (`checker/schedule.run_chunked` with
+``record_stats=False``) so the measured config IS the applied config.
+
+Persistence: ``store/autotune/<host-fingerprint>/<bucket>.json``
+(JGRAFT_AUTOTUNE_STORE overrides the root). The fingerprint hashes the
+STABLE host identity — cpu count, backend platform, device count,
+jax/jaxlib versions — deliberately excluding load averages: a busy host
+should not fork the plan store, but a toolchain swap or the r05→r06
+~2.9× host change MUST. A stale or foreign fingerprint, a corrupt file,
+or an unknown schema version all mean "re-measure, never silently
+mis-tune".
+
+Soundness: every candidate is a launch-shape configuration of the SAME
+kernels — chunk size, payload cap and fan-out never change which events
+are scanned or in what order beyond what the chunked-vs-monolithic
+equivalence already covers — so verdicts are bitwise-identical tuned vs
+default (pinned by tests/test_autotune.py and scripts/ab_autotune.py).
+``JGRAFT_AUTOTUNE=0`` disables consultation entirely and restores
+today's exact behavior.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..history.packing import (MACRO_MAX_OPENS, bucket_rows,
+                               macro_events_on, pack_batch,
+                               pack_macro_batch)
+from ..platform import env_int
+
+_log = logging.getLogger(__name__)
+
+#: Plan-file schema version; unknown versions are re-measured.
+PLAN_VERSION = 1
+
+#: Default plan-store root (gitignored alongside the test stores).
+DEFAULT_STORE = "store/autotune"
+
+
+def autotune_on() -> bool:
+    """Whether plans are consulted/measured at all. Default ON; the
+    measurement work-gates below keep small batches on the untuned
+    path, so tiny runs behave exactly as before either way.
+    JGRAFT_AUTOTUNE=0 restores today's behavior bit for bit. Parsed
+    defensively (platform.env_int): garbage warns and keeps the
+    default."""
+    return env_int("JGRAFT_AUTOTUNE", 1, minimum=0) != 0
+
+
+def sample_reps() -> int:
+    """Timed reps per candidate (after one untimed warm-up rep).
+    More reps harden the pick against host jitter at measurement
+    cost."""
+    return env_int("JGRAFT_AUTOTUNE_SAMPLES", 2, minimum=1)
+
+
+def min_rows() -> int:
+    """Work gate: groups with fewer rows than this never trigger a
+    measurement (loading a persisted plan is always allowed) — the
+    sample cost cannot amortize."""
+    return env_int("JGRAFT_AUTOTUNE_MIN_ROWS", 64, minimum=1)
+
+
+def min_cells() -> int:
+    """Second work gate: rows × events must reach this many scanned
+    cells before a measurement triggers."""
+    return env_int("JGRAFT_AUTOTUNE_MIN_CELLS", 1 << 16, minimum=1)
+
+
+def sample_rows_cap() -> int:
+    """Rows per candidate sample run. The sample must stay
+    representative of the LAUNCH shape the plan will drive — fan-out
+    cost scales with rows-per-device, so an 8-device candidate sampled
+    at 16 rows (2/device) mis-ranks against the full batch; 64 keeps
+    ≥8 rows/device on the widest fan-out this repo ships."""
+    return env_int("JGRAFT_AUTOTUNE_SAMPLE_ROWS", 64, minimum=1)
+
+
+def store_root() -> Path:
+    """Plan-store root; JGRAFT_AUTOTUNE_STORE overrides (defensively:
+    a blank value keeps the default rather than writing to cwd)."""
+    raw = os.environ.get("JGRAFT_AUTOTUNE_STORE", "")
+    raw = raw.strip() if raw else ""
+    return Path(raw) if raw else Path(DEFAULT_STORE)
+
+
+# --------------------------------------------------------- fingerprint
+
+
+def fingerprint_info() -> dict:
+    """The STABLE host identity a plan is valid for. Excludes load
+    averages on purpose (see module docstring)."""
+    info = {"cpu_count": os.cpu_count()}
+    try:
+        import jax
+
+        info["platform"] = jax.default_backend()
+        info["devices"] = len(jax.devices())
+        info["jax"] = jax.__version__
+    except Exception:  # noqa: BLE001 — fingerprinting must never raise
+        info["platform"] = "?"
+    try:
+        import jaxlib
+
+        info["jaxlib"] = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        info["jaxlib"] = "?"
+    return info
+
+
+def host_fingerprint() -> str:
+    """Short stable hash of `fingerprint_info` — the plan-store
+    directory key. A host change (r05→r06-style drift: different
+    cpu_count, toolchain, platform) lands in a different directory, so
+    stale tunings are never silently applied."""
+    raw = json.dumps(fingerprint_info(), sort_keys=True)
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------- plans
+
+
+@dataclass(frozen=True)
+class TunedPlan:
+    """One bucket's execution plan.
+
+    family:      kernel family tag the plan was measured for ("dense",
+                 "dense-mask", "sort") — recorded for reporting and as
+                 a guard: a plan never applies across families.
+    scan_chunk:  chunk size for the wavefront launch; 0 = one
+                 whole-schedule span (the monolithic reference shape).
+    macro_p:     macro payload cap for pack_macro_batch; 0 = the legacy
+                 one-event-per-step stream.
+    mesh_fanout: devices the launch fans out over (outer-bounded by
+                 JGRAFT_GROUP_DEVICES inside chunk_sharding); 1 =
+                 single-device.
+    """
+
+    family: str
+    scan_chunk: int
+    macro_p: int
+    mesh_fanout: int
+
+
+def default_plan(family: str) -> TunedPlan:
+    """Today's global defaults, as a plan — the baseline candidate
+    every measurement must beat."""
+    from ..parallel.mesh import chunk_sharding
+    from .schedule import scan_chunk
+
+    sharding = chunk_sharding()
+    fan = int(getattr(sharding, "mesh", None).size) if sharding is not None \
+        else 1
+    return TunedPlan(family=family, scan_chunk=scan_chunk(),
+                     macro_p=MACRO_MAX_OPENS if macro_events_on() else 0,
+                     mesh_fanout=fan)
+
+
+def bucket_signature(family: str, n_slots: int, n_states: int,
+                     n_rows: int, n_events: int) -> tuple:
+    """The shape bucket a plan is keyed by: kernel family, exact
+    window/state shape (they pick the compiled kernel), the
+    pow2+midpoint row/event buckets (they pick the launch shape — the
+    same series `pad_batch_bucketed` pads to, so two batches that share
+    compiled shapes share a plan), and the macro-stream mode: plans
+    measured under the macro stream must never leak into a
+    JGRAFT_MACRO_EVENTS=0 ablation run (the macro A/B must stay a pure
+    stream comparison)."""
+    return (family, int(n_slots), int(n_states),
+            bucket_rows(max(int(n_rows), 1)),
+            bucket_rows(max(int(n_events), 1), 32),
+            int(macro_events_on()))
+
+
+def _sig_name(sig: tuple) -> str:
+    fam, w, s, b, e, macro = sig
+    return f"{fam}-w{w}-s{s}-b{b}-e{e}-m{macro}.json"
+
+
+# ------------------------------------------------------ store + counters
+
+_LOCK = threading.Lock()
+_MISS = object()          # negative-cache sentinel (see plan_for)
+_MEM: dict = {}           # sig -> TunedPlan | _MISS (this process)
+_APPLIED: List[dict] = []  # bounded log of applied plans (service stamps)
+_APPLIED_SEQ = 0           # monotone id of the last applied entry
+_COUNTERS = {"plans_loaded": 0, "plans_measured": 0, "plan_misses": 0}
+
+
+def snapshot_counters() -> dict:
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def consume_counters() -> dict:
+    """Return and reset the counters (bench.py reads one rep's worth)."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+        return out
+
+
+def applied_log() -> List[dict]:
+    """Bounded log of {seq, signature, plan, source} entries, in
+    application order."""
+    with _LOCK:
+        return list(_APPLIED)
+
+
+def applied_seq() -> int:
+    """Monotone id of the most recent applied-plan entry. Callers
+    attributing plans to a span (graftd's scheduler) snapshot this
+    BEFORE the work and read `applied_since` after — slicing the
+    bounded log by LENGTH would break the moment trimming starts (the
+    length pins at the bound and the slice goes permanently empty)."""
+    with _LOCK:
+        return _APPLIED_SEQ
+
+
+def applied_since(seq: int) -> List[dict]:
+    """Entries applied after `seq` that are still inside the bounded
+    log (a span applying more than the bound keeps the newest)."""
+    with _LOCK:
+        return [dict(e) for e in _APPLIED if e["seq"] > seq]
+
+
+def _record_applied(sig: tuple, plan: TunedPlan, source: str) -> None:
+    global _APPLIED_SEQ
+    with _LOCK:
+        _APPLIED_SEQ += 1
+        _APPLIED.append({"seq": _APPLIED_SEQ, "signature": list(sig),
+                         "plan": asdict(plan), "source": source})
+        del _APPLIED[:-256]
+
+
+def reset_for_tests() -> None:
+    """Drop the in-memory plan cache + counters (tests simulate fresh
+    processes)."""
+    with _LOCK:
+        _MEM.clear()
+        _APPLIED.clear()
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+
+
+def _plan_path(sig: tuple) -> Path:
+    return store_root() / host_fingerprint() / _sig_name(sig)
+
+
+def plan_for(sig: tuple) -> Optional[TunedPlan]:
+    """Look a bucket's plan up: in-memory first, then the fingerprint
+    directory on disk. Corrupt files, schema drift, and fingerprint
+    mismatch (an operator copying plan files across hosts) all return
+    None — re-measure, never silently mis-tune.
+
+    Misses are negative-cached in memory: a long-lived daemon consults
+    per window group and per ladder rung on EVERY batch, and a
+    below-work-gate bucket would otherwise pay a disk stat per consult
+    forever. The sentinel is replaced by `save_plan` when this process
+    measures; a plan persisted by a DIFFERENT process mid-flight is
+    picked up on the next process start (acceptable — cross-process
+    plan sharing is a restart-time optimization, not a liveness
+    contract)."""
+    with _LOCK:
+        plan = _MEM.get(sig)
+    if plan is _MISS:
+        return None
+    if plan is not None:
+        _bump("plans_loaded")
+        _record_applied(sig, plan, "memory")
+        return plan
+    path = _plan_path(sig)
+    try:
+        raw = json.loads(path.read_text())
+    except FileNotFoundError:
+        return _miss(sig)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        _log.warning("autotune: unreadable plan %s (%s: %s) — "
+                     "re-measuring", path, type(e).__name__, e)
+        return _miss(sig)
+    try:
+        if raw.get("version") != PLAN_VERSION:
+            raise ValueError(f"schema version {raw.get('version')!r}")
+        if raw.get("fingerprint") != host_fingerprint():
+            raise ValueError("host fingerprint mismatch")
+        if raw.get("signature") != list(sig):
+            raise ValueError("bucket signature mismatch")
+        plan = TunedPlan(**{k: raw["plan"][k] for k in
+                            ("family", "scan_chunk", "macro_p",
+                             "mesh_fanout")})
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        _log.warning("autotune: stale/corrupt plan %s (%s: %s) — "
+                     "re-measuring", path, type(e).__name__, e)
+        return _miss(sig)
+    with _LOCK:
+        _MEM[sig] = plan
+    _bump("plans_loaded")
+    _record_applied(sig, plan, "disk")
+    return plan
+
+
+def _miss(sig: tuple):
+    with _LOCK:
+        _MEM[sig] = _MISS
+        _COUNTERS["plan_misses"] += 1
+    return None
+
+
+def _bump(key: str) -> None:
+    with _LOCK:
+        _COUNTERS[key] += 1
+
+
+def save_plan(sig: tuple, plan: TunedPlan, samples: dict) -> None:
+    """Persist a measured plan (atomic tmp+rename; persistence failures
+    warn and keep the in-memory plan — a read-only store must not break
+    checking)."""
+    with _LOCK:
+        _MEM[sig] = plan
+    path = _plan_path(sig)
+    payload = {
+        "version": PLAN_VERSION,
+        "fingerprint": host_fingerprint(),
+        "fingerprint_info": fingerprint_info(),
+        "signature": list(sig),
+        "plan": asdict(plan),
+        "samples": samples,
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(payload, indent=2))
+        os.replace(tmp, path)
+    except OSError as e:
+        _log.warning("autotune: could not persist plan %s (%s: %s)",
+                     path, type(e).__name__, e)
+
+
+# ----------------------------------------------------------- measurement
+
+
+def resolve_plan(sig: tuple, candidates: Sequence[TunedPlan],
+                 measure: Callable[[TunedPlan], float]) -> TunedPlan:
+    """Measure `candidates` interleaved (ab_macro.py discipline: one
+    untimed warm-up rep per candidate absorbs XLA compiles, then
+    `sample_reps` timed rounds with the candidate order rotating so
+    slow host drift cancels instead of biasing one candidate), pick the
+    best-of-min, persist, and return. The caller has already missed
+    `plan_for`."""
+    times: dict = {c: [] for c in candidates}
+    for c in candidates:     # warm-up: compile every candidate's shapes
+        measure(c)
+    reps = sample_reps()
+    for rep in range(reps):
+        order = list(candidates)[rep % len(candidates):] + \
+            list(candidates)[:rep % len(candidates)]
+        for c in order:
+            times[c].append(measure(c))
+    best = min(candidates, key=lambda c: min(times[c]))
+    samples = {json.dumps(asdict(c)): [round(t, 5) for t in ts]
+               for c, ts in times.items()}
+    save_plan(sig, best, samples)
+    _bump("plans_measured")
+    _record_applied(sig, best, "measured")
+    return best
+
+
+def pack_group(encs: Sequence, tuned: Optional[TunedPlan]) -> dict:
+    """Pack one group's encodings under a plan's macro payload cap —
+    or under today's defaults when no plan applies (tuned None). The
+    JGRAFT_MACRO_EVENTS=0 ablation is absolute: a persisted macro plan
+    must never re-enable the macro stream under it."""
+    if not macro_events_on():
+        return pack_batch(encs)
+    if tuned is None:
+        return pack_macro_batch(encs)
+    if tuned.macro_p <= 0:
+        return pack_batch(encs)
+    return pack_macro_batch(encs, cap=tuned.macro_p)
+
+
+def _chunk_candidates(default_chunk: int, e_sched: int) -> List[int]:
+    """Chunk sizes worth sampling for a schedule of `e_sched` events:
+    the global default, its neighbors, and 0 (one whole-schedule span).
+    Values ≥ the schedule collapse into 0's shape and are dropped."""
+    cands = [default_chunk, default_chunk * 2, 0]
+    out: List[int] = []
+    for c in cands:
+        if c >= max(e_sched, 1):
+            c = 0
+        if c not in out:
+            out.append(c)
+    return out
+
+
+def _fanout_candidates() -> List[int]:
+    """Fan-out widths worth sampling: the full mesh, a 2-device mesh,
+    and single-device. On hosts where devices are virtual (pin_cpu's
+    8 vdevs over 2 cores) the snug meshes routinely win — the
+    per-launch partition rendezvous scales with device count."""
+    from ..parallel.mesh import chunk_sharding
+
+    sharding = chunk_sharding()
+    full = int(sharding.mesh.size) if sharding is not None else 1
+    out = [full]
+    for n in (max(full // 2, 2), 2, 1):
+        if n < full and n not in out:
+            out.append(n)
+    return out
+
+
+def _macro_candidates() -> List[int]:
+    """Macro payload caps worth sampling. The macro stream's 1.8× win
+    is established, so the legacy stream (0) is only re-sampled via the
+    cap ladder's smallest rung — a narrower cap trades more rows for
+    narrower ones, which can win on the host."""
+    if not macro_events_on():
+        return [0]
+    return [MACRO_MAX_OPENS, 4]
+
+
+def tuned_group_plan(model, plan, encs: Sequence) -> Optional[TunedPlan]:
+    """Consult (and, for large-enough groups, measure) the plan for one
+    dense window group. `plan` is the group's ops.dense_scan.DensePlan;
+    `encs` the group's encodings in plan row order. Returns None —
+    today's exact behavior — when autotuning is off, the group is LONG
+    (the merged-cluster policies are separately measured), or the group
+    is below the work gates with no persisted plan."""
+    if not autotune_on() or not encs:
+        return None
+    from ..ops.dense_scan import MERGE_MAX_EVENTS
+
+    e_max = max(e.n_events for e in encs)
+    if e_max > MERGE_MAX_EVENTS:
+        return None
+    sig = bucket_signature(plan.kernel_tag, plan.n_slots, plan.n_states,
+                           len(encs), e_max)
+    found = plan_for(sig)
+    if found is not None:
+        return found
+    if len(encs) < min_rows() or len(encs) * e_max < min_cells():
+        return None
+    k = min(len(encs), sample_rows_cap())
+    sample = list(encs[:k])
+    val_of = np.asarray(plan.val_of[:k])
+    e_sched = bucket_rows(e_max, 32)
+
+    def measure(cand: TunedPlan) -> float:
+        return _run_dense_sample(model, plan, sample, val_of, cand)
+
+    candidates = _coordinate_candidates(plan.kernel_tag, e_sched)
+    return resolve_plan(sig, candidates, measure)
+
+
+def _coordinate_candidates(family: str, e_sched: int) -> List[TunedPlan]:
+    """The candidate grid, kept deliberately small (each distinct
+    launch shape is an XLA compile during measurement): chunk ladder ×
+    {default fan-out} plus fan-out ladder × {default chunk} plus macro
+    ladder × {default chunk+fanout} — a star around the default rather
+    than the full cross product."""
+    base = default_plan(family)
+    out: List[TunedPlan] = [base]
+
+    def add(**kw):
+        c = TunedPlan(**{**asdict(base), **kw})
+        if c not in out:
+            out.append(c)
+
+    for chunk in _chunk_candidates(base.scan_chunk or 128, e_sched):
+        add(scan_chunk=chunk)
+    for fan in _fanout_candidates():
+        add(mesh_fanout=fan)
+    for p in _macro_candidates():
+        add(macro_p=p)
+    return out
+
+
+def _run_dense_sample(model, plan, sample: Sequence, val_of: np.ndarray,
+                      cand: TunedPlan) -> float:
+    """One timed sample run of a dense group candidate, through the
+    exact launch path the plan will drive (build_dense_launches'
+    placement mapping, run_chunked driver, stats suppressed)."""
+    from ..ops.dense_scan import make_dense_chunk_checker
+    from ..parallel.mesh import chunk_sharding
+    from .schedule import ChunkLaunch, run_chunked
+
+    batch = pack_group(sample, cand)
+    e_len = batch["events"].shape[1]
+    e_sched = bucket_rows(e_len, 32)
+    sharding = chunk_sharding(cand.mesh_fanout)
+    init_fn, step_fn = make_dense_chunk_checker(
+        model, plan.kind, plan.n_slots, plan.n_states,
+        mesh=getattr(sharding, "mesh", None),
+        macro_p=batch.get("macro_p"))
+    chunk = cand.scan_chunk or max(e_sched, 1)
+    launch = ChunkLaunch(
+        events=batch["events"], n_events=batch["n_events"],
+        init_fn=init_fn, step_fn=step_fn, val_of=val_of,
+        e_sched=e_sched, device=sharding, tag="autotune-sample",
+        chunk=chunk)
+    t0 = time.perf_counter()
+    run_chunked([launch], chunk=chunk, record_stats=False)
+    return time.perf_counter() - t0
+
+
+def tuned_sort_plan(model, encs: Sequence, n_configs: int,
+                    n_slots: int) -> Optional[TunedPlan]:
+    """Sort-ladder twin of `tuned_group_plan` for one capacity rung;
+    the rung's frontier capacity rides the signature's state slot (it
+    picks the compiled kernel exactly like S does for the dense
+    family).
+
+    The sort rung's base plan pins `mesh_fanout=1` — TODAY'S behavior:
+    unlike the dense groups, the pre-autotune sort rung never got the
+    PR 3 mesh fan-out (single-device vmap). That makes fan-out the
+    rung's headline candidate dimension: on the 8-vdev host mesh a
+    fanned-out sort rung measured 1.84× over the single-device default
+    at the wide-domain register shape (2026-08-04, this host) — the
+    kind of per-bucket mis-calibration this module exists to find."""
+    if not autotune_on() or not encs:
+        return None
+    e_max = max(e.n_events for e in encs)
+    sig = bucket_signature("sort", n_slots, n_configs, len(encs), e_max)
+    found = plan_for(sig)
+    if found is not None:
+        return found
+    if len(encs) < min_rows() or len(encs) * e_max < min_cells():
+        return None
+    sample = list(encs[:min(len(encs), sample_rows_cap())])
+    e_sched = bucket_rows(e_max, 32)
+
+    def measure(cand: TunedPlan) -> float:
+        return _run_sort_sample(model, n_configs, n_slots, sample, cand)
+
+    base = TunedPlan(**{**asdict(default_plan("sort")), "mesh_fanout": 1})
+    candidates: List[TunedPlan] = [base]
+    for chunk in _chunk_candidates(base.scan_chunk or 128, e_sched):
+        c = TunedPlan(**{**asdict(base), "scan_chunk": chunk})
+        if c not in candidates:
+            candidates.append(c)
+    for fan in _fanout_candidates():
+        c = TunedPlan(**{**asdict(base), "mesh_fanout": fan})
+        if c not in candidates:
+            candidates.append(c)
+    for p in _macro_candidates():
+        c = TunedPlan(**{**asdict(base), "macro_p": p})
+        if c not in candidates:
+            candidates.append(c)
+    return resolve_plan(sig, candidates, measure)
+
+
+def sort_rung_sharding(tuned: Optional[TunedPlan]):
+    """The sort rung's launch placement under a plan: None (today's
+    single-device rung) without a plan or at fanout ≤ 1, else the
+    capped batch-axis sharding."""
+    if tuned is None or tuned.mesh_fanout <= 1:
+        return None
+    from ..parallel.mesh import chunk_sharding
+
+    return chunk_sharding(tuned.mesh_fanout)
+
+
+def _run_sort_sample(model, n_configs: int, n_slots: int,
+                     sample: Sequence, cand: TunedPlan) -> float:
+    from ..ops.linear_scan import make_sort_chunk_checker
+    from .schedule import ChunkLaunch, run_chunked
+
+    batch = pack_group(sample, cand)
+    e_sched = bucket_rows(batch["events"].shape[1], 32)
+    sharding = sort_rung_sharding(cand)
+    init_fn, step_fn = make_sort_chunk_checker(
+        model, n_configs, n_slots, mesh=getattr(sharding, "mesh", None),
+        macro_p=batch.get("macro_p"))
+    chunk = cand.scan_chunk or max(e_sched, 1)
+    launch = ChunkLaunch(
+        events=batch["events"], n_events=batch["n_events"],
+        init_fn=init_fn, step_fn=step_fn, e_sched=e_sched,
+        device=sharding, tag="autotune-sample", chunk=chunk)
+    t0 = time.perf_counter()
+    run_chunked([launch], chunk=chunk, record_stats=False)
+    return time.perf_counter() - t0

@@ -55,6 +55,7 @@ from ..ops.linear_scan import (DEFAULT_N_CONFIGS, MAX_SLOTS, bucket_slots,
                                make_batch_checker, make_sort_chunk_checker)
 from ..ops.segment_scan import LONG_HISTORY_MIN_EVENTS, check_segmented_batch
 from ..platform import degraded_note, env_int
+from . import autotune
 from .base import Checker, INVALID, UNKNOWN, VALID
 from .dfs_cpu import SearchBudgetExceeded, check_encoded_dfs
 from .schedule import (ChunkLaunch, build_dense_launches, run_chunked,
@@ -371,8 +372,19 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             triples = []
             for idxs, plan in grouped:
                 sub = [fits[j] for j in idxs]
-                triples.append((sub, plan,
-                                _group_pack([encs[i] for i in sub])))
+                sub_encs = [encs[i] for i in sub]
+                # Per-bucket autotuned plan (checker/autotune.py):
+                # consulted per window group — a persisted plan loads,
+                # a big-enough unplanned bucket measures once in
+                # process, everything else (JGRAFT_AUTOTUNE=0, small
+                # groups, LONG clusters) keeps today's defaults. The
+                # plan's macro payload cap acts here at pack time; its
+                # chunk/fan-out halves act in build_dense_launches.
+                tuned = autotune.tuned_group_plan(model, plan, sub_encs)
+                batch = (autotune.pack_group(sub_encs, tuned)
+                         if tuned is not None
+                         else _group_pack(sub_encs))
+                triples.append((sub, plan, batch, tuned))
             launches, subs = build_dense_launches(
                 model, triples, host_route=_route_group_to_host)
             with _maybe_profile():
@@ -496,23 +508,39 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
         for rung, eff_configs in enumerate(ladder):
             # The C-ladder consumes the macro stream too: every rung's
             # kernel (chunked or monolithic) keys on the batch's P.
-            batch = _group_pack([encs[i] for i in remaining])
+            # The rung consults the autotuner like the dense groups do
+            # (family "sort", capacity in the signature).
+            rung_encs = [encs[i] for i in remaining]
+            tuned = (autotune.tuned_sort_plan(model, rung_encs,
+                                              eff_configs, eff_slots)
+                     if scan_chunk() > 0 else None)
+            batch = (autotune.pack_group(rung_encs, tuned)
+                     if tuned is not None else _group_pack(rung_encs))
             t0 = time.perf_counter()
             if scan_chunk() > 0:
                 # Chunked sort scan (ISSUE 3): same rung, but decided
                 # rows evict between chunks and the rung early-exits
                 # when every row is decided. The ladder still blocks
                 # per rung — the escalation decision needs the flags.
+                # A tuned fan-out shards the rung over the mesh — the
+                # pre-autotune rung was single-device, which the 8-vdev
+                # host measured 1.84× slower at sort shapes (autotune
+                # docstring); no plan keeps today's placement.
+                rung_sharding = autotune.sort_rung_sharding(tuned)
                 init_fn, step_fn = make_sort_chunk_checker(
                     model, eff_configs, eff_slots,
+                    mesh=getattr(rung_sharding, "mesh", None),
                     macro_p=batch.get("macro_p"))
+                e_sched = bucket_rows(batch["events"].shape[1], 32)
                 with _maybe_profile():
                     [out] = run_chunked([ChunkLaunch(
                         events=batch["events"],
                         n_events=batch["n_events"],
                         init_fn=init_fn, step_fn=step_fn,
-                        e_sched=bucket_rows(batch["events"].shape[1], 32),
-                        tag="sort")])
+                        e_sched=e_sched, device=rung_sharding,
+                        tag="sort",
+                        chunk=(tuned.scan_chunk or max(e_sched, 1))
+                        if tuned is not None else None)])
                 ok, overflow = out.ok, out.overflow
             else:
                 kernel = make_batch_checker(model, eff_configs, eff_slots,

@@ -87,6 +87,12 @@ class PerfChecker(Checker):
         scan = scan_stats_summary()
         if scan is not None:
             out["scan-stats"] = scan
+        # Autotune evidence (PR 6): which per-bucket plans this process
+        # has loaded/measured so far — absent when the autotuner never
+        # engaged (off, or every group below the work gates).
+        tune = autotune_summary()
+        if tune is not None:
+            out["autotune"] = tune
         store_dir = (test or {}).get("store_dir")
         if self.render and store_dir:
             try:
@@ -127,6 +133,20 @@ def scan_stats_summary():
     from .schedule import snapshot_stats
 
     return format_scan_stats(snapshot_stats(scoped=True))
+
+
+def autotune_summary():
+    """Process-level autotuner counters (checker/autotune.py), or None
+    when the autotuner has not engaged — absent beats all-zero in
+    stored results, same stance as the scan counters."""
+    from .autotune import snapshot_counters
+
+    c = snapshot_counters()
+    if not any(c.values()):
+        return None
+    return {"plans-loaded": c["plans_loaded"],
+            "plans-measured": c["plans_measured"],
+            "plan-misses": c["plan_misses"]}
 
 
 #: fault-op f → healing-op f (the start/stop convention nemesis packages

@@ -202,6 +202,12 @@ class ChunkLaunch:
     #: tiny, so eviction's value there is the early exit, not bucket
     #: shrinking, and per-eviction exact shapes would recompile.
     exact_rows: bool = False
+    #: Per-launch chunk override (checker/autotune.py plans): None
+    #: inherits the run-wide chunk (JGRAFT_SCAN_CHUNK), a positive
+    #: value pins THIS launch's chunk — a whole-schedule value makes
+    #: the launch effectively monolithic (one span, one flag sync)
+    #: while staying on the wavefront driver.
+    chunk: Optional[int] = None
 
 
 @dataclass
@@ -224,6 +230,7 @@ class GroupOutcome:
 class _GroupState:
     launch: ChunkLaunch
     padded_events: np.ndarray          # [B_real, E_pad, 5]
+    chunk: int                         # this group's resolved chunk size
     scheduled: int                     # chunks the monolithic path implies
     slot_rows: np.ndarray              # [padded_B] original row id or -1
     carry: object                      # device pytree
@@ -246,15 +253,18 @@ def build_dense_launches(model, groups, host_route=None):
     one home of the placement policy (checker/_jax_pass and
     bench.run_chunks both route through it).
 
-    groups: iterable of (rows, plan, batch) — `rows` the caller's row
-    ids, `plan` a DensePlan, `batch` the group's pack_batch OR
-    pack_macro_batch dict (a "macro_p" key routes the group through
-    the macro-event chunk kernels; `n_events` then counts macro rows,
-    which is exactly what the span/exhaustion math must run on). The
-    launch order is policy and lives HERE: largest group first, so big
-    groups' chunks queue ahead of small ones on every device (callers
-    must not pre-sort — the bench and the checker must measure the
-    same schedule).
+    groups: iterable of (rows, plan, batch) or (rows, plan, batch,
+    tuned) — `rows` the caller's row ids, `plan` a DensePlan, `batch`
+    the group's pack_batch OR pack_macro_batch dict (a "macro_p" key
+    routes the group through the macro-event chunk kernels; `n_events`
+    then counts macro rows, which is exactly what the span/exhaustion
+    math must run on), `tuned` an optional checker/autotune.py
+    TunedPlan applying this group's measured {scan_chunk, mesh_fanout}
+    (the macro payload half of a plan acts earlier, at pack time —
+    autotune.pack_group). The launch order is policy and lives HERE:
+    largest group first, so big groups' chunks queue ahead of small
+    ones on every device (callers must not pre-sort — the bench and
+    the checker must measure the same schedule).
     host_route(n_rows_bucketed, e_len) -> bool optionally routes a
     whole group to the host cpu device (the PLATFORM_ROUTE_MIN_CELLS
     gate). Returns (launches, subs): subs[k] holds the row ids behind
@@ -282,10 +292,11 @@ def build_dense_launches(model, groups, host_route=None):
     from ..parallel.mesh import chunk_sharding
 
     sharding = chunk_sharding()
-    mesh = getattr(sharding, "mesh", None)
     launches: list = []
     subs: list = []
-    for rows, plan, batch in sorted(groups, key=lambda g: -len(g[0])):
+    for grp in sorted(groups, key=lambda g: -len(g[0])):
+        rows, plan, batch = grp[:3]
+        tuned = grp[3] if len(grp) > 3 else None
         e_len = batch["events"].shape[1]
         # Both the LONG-group exact-padding policy and the host/TPU
         # cell gate were calibrated on LEGACY event counts; a macro
@@ -310,17 +321,30 @@ def build_dense_launches(model, groups, host_route=None):
 
             tag += "@host"
             placement = jax.devices("cpu")[0]
+        elif exact:
+            placement = None
+        elif tuned is not None and tuned.mesh_fanout > 0:
+            # Per-group fan-out from the measured plan; the env knob
+            # (JGRAFT_GROUP_DEVICES) stays the outer bound inside
+            # chunk_sharding.
+            placement = chunk_sharding(tuned.mesh_fanout)
         else:
-            placement = None if exact else sharding
+            placement = sharding
+        # A tuned scan_chunk pins this launch's chunk; 0 means "one
+        # whole-schedule span" — effectively the monolithic reference
+        # launch, still on the wavefront driver (same verdict path).
+        chunk_override = None
+        if tuned is not None and not exact:
+            chunk_override = tuned.scan_chunk or max(e_sched, 1)
         init_fn, step_fn = make_dense_chunk_checker(
             model, plan.kind, plan.n_slots, plan.n_states,
-            mesh=mesh if placement is sharding else None,
+            mesh=getattr(placement, "mesh", None),
             macro_p=batch.get("macro_p"))
         launches.append(ChunkLaunch(
             events=batch["events"], n_events=batch["n_events"],
             init_fn=init_fn, step_fn=step_fn, val_of=plan.val_of,
             e_sched=e_sched, device=placement, tag=tag,
-            exact_rows=exact))
+            exact_rows=exact, chunk=chunk_override))
         subs.append(list(rows))
     return launches, subs
 
@@ -354,6 +378,7 @@ def _pad_idx(positions: List[int], bucket: int) -> np.ndarray:
 def _init_group(launch: ChunkLaunch, chunk: int) -> _GroupState:
     import jax
 
+    chunk = launch.chunk or chunk  # per-launch autotune override
     B, E = launch.events.shape[0], launch.events.shape[1]
     e_sched = max(launch.e_sched or E, E, 1)
     e_pad = ((e_sched + chunk - 1) // chunk) * chunk
@@ -381,7 +406,8 @@ def _init_group(launch: ChunkLaunch, chunk: int) -> _GroupState:
     else:
         carry = launch.init_fn(put(ne))
     return _GroupState(
-        launch=launch, padded_events=padded, scheduled=e_pad // chunk,
+        launch=launch, padded_events=padded, chunk=chunk,
+        scheduled=e_pad // chunk,
         slot_rows=slot_rows, carry=carry,
         ok=np.zeros((B,), dtype=bool), overflow=np.zeros((B,), dtype=bool),
         recorded=np.zeros((B,), dtype=bool), t_start=time.perf_counter())
@@ -399,7 +425,7 @@ def _chunk_slice(g: _GroupState, lo: int, width: int) -> np.ndarray:
     return ev
 
 
-def _span_chunks(g: _GroupState, chunk: int) -> int:
+def _span_chunks(g: _GroupState) -> int:
     """How many chunks the next launch coalesces. Flag syncs only pay
     for themselves at boundaries where a row can actually retire, and
     exhaustion is host-predictable: `n_events` is host data, so no live
@@ -415,6 +441,7 @@ def _span_chunks(g: _GroupState, chunk: int) -> int:
     `decided` (~ok) row inside a coalesced span is caught at the next
     sync — its verdict is frozen (see module docstring), so it is
     recorded late, never differently; only eviction latency moves."""
+    chunk = g.chunk
     live = g.slot_rows[g.slot_rows >= 0]
     live = live[~g.recorded[live]]
     lo = g.cursor * chunk
@@ -424,18 +451,18 @@ def _span_chunks(g: _GroupState, chunk: int) -> int:
     return 1 << (p.bit_length() - 1) if p > 1 else 1
 
 
-def _dispatch(g: _GroupState, chunk: int) -> None:
+def _dispatch(g: _GroupState) -> None:
     import jax
 
-    span = _span_chunks(g, chunk)
-    ev = _chunk_slice(g, g.cursor * chunk, span * chunk)
+    span = _span_chunks(g)
+    ev = _chunk_slice(g, g.cursor * g.chunk, span * g.chunk)
     if g.launch.device is not None:
         ev = jax.device_put(ev, g.launch.device)
     t0 = time.perf_counter()
     g.pending = (t0, span, g.launch.step_fn(g.carry, ev))
 
 
-def _collect(g: _GroupState, chunk: int) -> None:
+def _collect(g: _GroupState) -> None:
     """Block for the pending launch, record finished rows, evict, and
     recompact survivors when they fit a smaller row bucket."""
     import jax
@@ -518,38 +545,47 @@ def _overlap_seconds(intervals: List[tuple]) -> float:
 
 
 def run_chunked(launches: List[ChunkLaunch],
-                chunk: Optional[int] = None) -> List[GroupOutcome]:
+                chunk: Optional[int] = None,
+                record_stats: bool = True) -> List[GroupOutcome]:
     """Run window groups through the chunked wavefront; one
     GroupOutcome per launch, in order. Each round dispatches every live
     group's next chunk before blocking on any result, so group kernels
-    overlap on their per-group devices (JAX async dispatch)."""
+    overlap on their per-group devices (JAX async dispatch).
+    A launch's own `chunk` field overrides the run-wide `chunk`
+    (autotuned per-group plans). `record_stats=False` keeps a run out
+    of the process/scope counters — the autotuner's short candidate
+    samples must not inflate the eviction evidence bench.py and the
+    per-run stores report."""
     chunk = scan_chunk() if chunk is None else chunk
-    if chunk <= 0:
+    if chunk <= 0 and not (launches and all(ln.chunk for ln in launches)):
         raise ValueError("run_chunked needs a positive chunk size "
                          "(JGRAFT_SCAN_CHUNK=0 selects the legacy "
-                         "monolithic path at the call site)")
+                         "monolithic path at the call site; per-launch "
+                         "ChunkLaunch.chunk overrides may substitute)")
     groups = [_init_group(ln, chunk) for ln in launches]
     for g in groups:
-        _dispatch(g, chunk)
+        _dispatch(g)
     while True:
         live = [g for g in groups if not g.done]
         if not live:
             break
         for g in live:
-            _collect(g, chunk)
+            _collect(g)
             if not g.done:
                 # Refill this launch's device queue BEFORE collecting
                 # the next one (streaming, not bulk-synchronous): a
                 # round barrier would drain every device queue while
                 # the host walks the collect order, and the bubble is
                 # pure loss on both the tunnel and the host.
-                _dispatch(g, chunk)
+                _dispatch(g)
     all_spans = [iv for g in groups for iv in g.intervals]
-    _add_stats(chunks_run=sum(g.launches_run for g in groups),
-               evicted_rows=sum(g.evicted for g in groups),
-               groups_run=len(groups),
-               groups_early_exited=sum(1 for g in groups if g.early_exit),
-               pipeline_overlap_s=_overlap_seconds(all_spans))
+    if record_stats:
+        _add_stats(chunks_run=sum(g.launches_run for g in groups),
+                   evicted_rows=sum(g.evicted for g in groups),
+                   groups_run=len(groups),
+                   groups_early_exited=sum(1 for g in groups
+                                           if g.early_exit),
+                   pipeline_overlap_s=_overlap_seconds(all_spans))
     return [GroupOutcome(ok=g.ok, overflow=g.overflow, wall_s=g.wall_s,
                          chunks_run=g.launches_run, evicted_rows=g.evicted,
                          early_exit=g.early_exit, tag=g.launch.tag)

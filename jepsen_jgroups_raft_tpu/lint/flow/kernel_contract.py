@@ -37,9 +37,12 @@ Rules
     never a silent pass, so adding symbols to a kernel without extending
     its contract fails the gate instead of going unchecked.
 
-Scan set (CLI): ``ops/pallas_scan.py``, ``ops/segment_scan.py``,
-``ops/dense_scan.py``, ``parallel/mesh.py``, ``history/packing.py`` —
-the non-Pallas files are covered for their declared cap/budget
+Scan set (CLI): ``ops/kernel_ir.py``, ``ops/pallas_scan.py``,
+``ops/segment_scan.py``, ``ops/dense_scan.py``, ``ops/linear_scan.py``,
+``parallel/mesh.py``, ``history/packing.py`` — the kernel IR carries
+THE chunk-carry bindings for every family that chunks through it
+(``_ir_chunk_budget``; the per-family duplicates are gone, PR 6), the
+other non-Pallas files are covered for their declared cap/budget
 constants (incl. the macro-event ``MACRO_MAX_OPENS`` payload cap, whose
 67-lane rows the Pallas tile and chunk-slab bindings sample) and for
 any ``pallas_call`` a future PR adds there.
@@ -115,29 +118,52 @@ def _pallas_scan_tile_budget(interp: Interp) -> List[str]:
     return out
 
 
-def _dense_chunk_budget(interp: Interp) -> List[str]:
-    """The chunked entry points (ISSUE 3) carry per-row scan state
+def _ir_chunk_budget(interp: Interp) -> List[str]:
+    """THE chunk-carry contract bindings — proven once against the
+    kernel IR (ops/kernel_ir.py) for every family that chunks through
+    it, replacing the per-family dense/sort duplicates (PR 6
+    satellite). The chunked entry points carry per-row scan state
     between kernel launches instead of rebuilding it — so the carry
-    itself must fit the VMEM envelope at the eligibility caps.
-    Executes `dense_chunk_carry_bytes` statically over the cap corners
-    (the same loud-not-silent stance as the Pallas tile invariant)."""
+    itself must fit the VMEM envelope at the eligibility caps, which
+    live in the same module (a cap bump and an accounting change fail
+    the gate together). Same loud-not-silent stance as the Pallas tile
+    invariant: anything unresolvable is a kernel-unresolved finding."""
     out = []
-    fn = interp.functions.get("dense_chunk_carry_bytes")
+    fn_d = interp.functions.get("dense_chunk_carry_bytes")
     caps_w = interp.module_env.get("DENSE_MAX_SLOTS")
     caps_s = interp.module_env.get("DENSE_MAX_STATES")
     mask_w = interp.module_env.get("MASK_DENSE_MAX_SLOTS")
-    if fn is None or not all(isinstance(v, int)
-                             for v in (caps_w, caps_s, mask_w)):
-        return [("kernel-unresolved",
-                 "dense_chunk_carry_bytes / dense caps not resolvable")]
-    for W, S in ((1, 1), (caps_w, 1), (caps_w, caps_s), (mask_w, 1)):
-        n = interp.exec_fn(fn, {"n_slots": W, "n_states": S})
-        if not isinstance(n, int):
-            out.append(("kernel-unresolved",
-                        f"dense_chunk_carry_bytes({W}, {S}) not evaluable"))
-        elif n > 16 << 20:
-            out.append(f"chunked dense carry at (W={W}, S={S}) = {n} B "
-                       "exceeds usable per-core VMEM")
+    if fn_d is None or not all(isinstance(v, int)
+                               for v in (caps_w, caps_s, mask_w)):
+        out.append(("kernel-unresolved",
+                    "dense_chunk_carry_bytes / dense caps not resolvable"))
+    else:
+        for W, S in ((1, 1), (caps_w, 1), (caps_w, caps_s), (mask_w, 1)):
+            n = interp.exec_fn(fn_d, {"n_slots": W, "n_states": S})
+            if not isinstance(n, int):
+                out.append(("kernel-unresolved",
+                            f"dense_chunk_carry_bytes({W}, {S}) "
+                            "not evaluable"))
+            elif n > 16 << 20:
+                out.append(f"chunked dense carry at (W={W}, S={S}) = {n} "
+                           "B exceeds usable per-core VMEM")
+    fn_s = interp.functions.get("sort_chunk_carry_bytes")
+    n_cfg = interp.module_env.get("SORT_DEFAULT_CONFIGS")
+    n_slots = interp.module_env.get("SORT_MAX_SLOTS")
+    if fn_s is None or not all(isinstance(v, int)
+                               for v in (n_cfg, n_slots)):
+        out.append(("kernel-unresolved",
+                    "sort_chunk_carry_bytes / sort caps not resolvable"))
+    else:
+        for C, W in ((n_cfg, 1), (n_cfg, n_slots), (4 * n_cfg, n_slots)):
+            n = interp.exec_fn(fn_s, {"n_configs": C, "n_slots": W})
+            if not isinstance(n, int):
+                out.append(("kernel-unresolved",
+                            f"sort_chunk_carry_bytes({C}, {W}) "
+                            "not evaluable"))
+            elif n > 16 << 20:
+                out.append(f"chunked sort carry at (C={C}, W={W}) = {n} B "
+                           "exceeds usable per-core VMEM")
     # Macro-event rows (ISSUE-4): the widened chunk event slab must
     # still fit next to the carry at the caps. MACRO_MAX_OPENS comes
     # from history/packing.py via the sibling-constant merge; a cap
@@ -149,35 +175,21 @@ def _dense_chunk_budget(interp: Interp) -> List[str]:
                     "macro_row_ints / MACRO_MAX_OPENS not resolvable"))
         return out
     r = interp.exec_fn(fn_r, {"macro_p": cap_p})
-    carry = interp.exec_fn(fn, {"n_slots": caps_w, "n_states": caps_s})
-    if not (isinstance(r, int) and isinstance(carry, int)):
+    if not isinstance(r, int):
         out.append(("kernel-unresolved",
                     f"macro_row_ints({cap_p}) not evaluable"))
-    elif carry + 4096 * r * 4 > 16 << 20:
-        out.append(f"chunked dense carry + macro event slab at the caps "
-                   f"= {carry + 4096 * r * 4} B exceeds usable per-core "
-                   "VMEM")
-    return out
-
-
-def _sort_chunk_budget(interp: Interp) -> List[str]:
-    """Same invariant for the sort kernel's chunked carry, at the
-    default capacity and the hard window cap."""
-    out = []
-    fn = interp.functions.get("sort_chunk_carry_bytes")
-    n_cfg = interp.module_env.get("DEFAULT_N_CONFIGS")
-    n_slots = interp.module_env.get("MAX_SLOTS")
-    if fn is None or not all(isinstance(v, int) for v in (n_cfg, n_slots)):
-        return [("kernel-unresolved",
-                 "sort_chunk_carry_bytes / sort caps not resolvable")]
-    for C, W in ((n_cfg, 1), (n_cfg, n_slots), (4 * n_cfg, n_slots)):
-        n = interp.exec_fn(fn, {"n_configs": C, "n_slots": W})
-        if not isinstance(n, int):
-            out.append(("kernel-unresolved",
-                        f"sort_chunk_carry_bytes({C}, {W}) not evaluable"))
-        elif n > 16 << 20:
-            out.append(f"chunked sort carry at (C={C}, W={W}) = {n} B "
-                       "exceeds usable per-core VMEM")
+        return out
+    # Carry + slab only when the dense half resolved — its absence was
+    # already reported above with the RIGHT cause; re-blaming
+    # macro_row_ints here would point the maintainer at the wrong fn.
+    if fn_d is not None and all(isinstance(v, int)
+                                for v in (caps_w, caps_s)):
+        carry = interp.exec_fn(fn_d, {"n_slots": caps_w,
+                                      "n_states": caps_s})
+        if isinstance(carry, int) and carry + 4096 * r * 4 > 16 << 20:
+            out.append(f"chunked dense carry + macro event slab at the "
+                       f"caps = {carry + 4096 * r * 4} B exceeds usable "
+                       "per-core VMEM")
     return out
 
 
@@ -221,22 +233,37 @@ CONTRACTS: Dict[str, Contract] = {
         ("3 + 4 * MACRO_MAX_OPENS", 67,
          "macro row width beyond the proven R samples"),
     ]),
-    "ops/dense_scan.py": Contract(const_asserts=[
+    # The IR owns the family caps and the chunk-carry accounting; its
+    # contract carries THE single set of chunk-carry bindings
+    # (_ir_chunk_budget) plus the cap const-asserts that used to live
+    # per family.
+    "ops/kernel_ir.py": Contract(const_asserts=[
         ("(1 << DENSE_MAX_SLOTS) * DENSE_MAX_STATES * 4", 16 << 20,
          "dense frontier at the eligibility caps exceeds VMEM"),
         ("DENSE_MAX_CELLS * 4", 16 << 20,
          "dense cell cap exceeds VMEM"),
         ("(1 << MASK_DENSE_MAX_SLOTS) * 8", 16 << 20,
          "mask frontier + subset-sum lane at the cap exceeds VMEM"),
-    ], custom=_dense_chunk_budget),
-    "ops/linear_scan.py": Contract(const_asserts=[
         # 4 mask words must keep a spare top bit for the all-ones
-        # empty-entry sentinel (module docstring soundness argument).
+        # empty-entry sentinel (linear_scan docstring soundness
+        # argument).
+        ("SORT_MAX_SLOTS", 127,
+         "window cap would consume the sentinel bit of the last word"),
+        ("SORT_DEFAULT_CONFIGS * ((SORT_MAX_SLOTS // 32 + 1) * 4 + 4)",
+         16 << 20,
+         "sort frontier at the default capacity exceeds VMEM"),
+    ], custom=_ir_chunk_budget),
+    "ops/dense_scan.py": Contract(const_asserts=[
+        # Re-assert the caps through dense_scan's own import site: the
+        # sibling-constant merge resolves them from kernel_ir, so a
+        # broken re-export chain is a loud unresolved finding here.
+        ("(1 << DENSE_MAX_SLOTS) * DENSE_MAX_STATES * 4", 16 << 20,
+         "dense frontier at the eligibility caps exceeds VMEM"),
+    ]),
+    "ops/linear_scan.py": Contract(const_asserts=[
         ("MAX_SLOTS", 127,
          "window cap would consume the sentinel bit of the last word"),
-        ("DEFAULT_N_CONFIGS * ((MAX_SLOTS // 32 + 1) * 4 + 4)", 16 << 20,
-         "sort frontier at the default capacity exceeds VMEM"),
-    ], custom=_sort_chunk_budget),
+    ]),
     "ops/segment_scan.py": Contract(const_asserts=[
         ("MAX_BASIS * DENSE_MAX_CELLS * 4", 16 << 20,
          "segment seed-basis frontier at the caps exceeds VMEM"),

@@ -32,7 +32,8 @@ Mechanics per event (same event stream as linear_scan — packing.py):
   FORCE w: survivors must hold bit w (mask with the bit column derived
            arithmetically from the dynamic slot id), then the bit is
            recycled by moving the bit-w=1 half onto the bit-w=0 half —
-           one `dynamic_slice` down-shift (`_force_arith`; switch-free,
+           one `dynamic_slice` down-shift (kernel_ir.force_arith;
+           switch-free,
            ISSUE 4 — the old `lax.switch` evaluated all W branches
            under vmap).
 
@@ -51,23 +52,21 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
-from ..history.packing import (EV_FORCE, EV_OPEN, MACRO_MAX_OPENS,
-                               EncodedHistory)
-
-#: Eligibility caps. Per-event work is ~W · 2^W · S² (closure sweeps)
-#: plus 2^W · S (the arithmetic FORCE path), so the dense path is
-#: reserved for genuinely small problems — which the reference's own
-#: workload shapes are (window ≈ n_procs, domain ≈ 5 values; a few
-#: crashed ops' never-retiring slots push long histories to W ≈ 10).
-DENSE_MAX_SLOTS = 10
-DENSE_MAX_STATES = 16
-DENSE_MAX_CELLS = 8192  # 2^W · S
-
-#: Mask mode has no state dimension (S² → 1), so it affords a wider
-#: window: 2^12 bool cells + an int32 subset-sum lane per history.
-MASK_DENSE_MAX_SLOTS = 12
+from ..history.packing import EncodedHistory
+# The shared step-parts substrate (PR 6 tentpole): eligibility caps,
+# macro-latch helpers, the arithmetic FORCE dispatch, the stream-step
+# assembly and both drivers live in ops/kernel_ir.py — this module
+# keeps only the dense state-representation lowering. The caps and
+# helpers are re-exported here so routing layers and tests keep their
+# historical import sites.
+from .kernel_ir import (DENSE_MAX_CELLS, DENSE_MAX_SLOTS, DENSE_MAX_STATES,
+                        MASK_DENSE_MAX_SLOTS, KernelParts,
+                        batch_chunk_checker, closure_fixpoint, force_arith,
+                        macro_latch_i32, make_stream_step, monolithic_check,
+                        scan_unroll)
+from .kernel_ir import dense_chunk_carry_bytes  # noqa: F401  (re-export)
+from .kernel_ir import macro_row_ints  # noqa: F401  (re-export)
 
 
 @dataclass(frozen=True)
@@ -353,112 +352,10 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
     return groups, rest
 
 
-def scan_unroll() -> int:
-    """Events per lax.scan step across the event-scan kernels (dense,
-    mask, segment, sort) — an ablation knob for the on-chip sweep
-    (scripts/calibrate_routing.py --unroll), JGRAFT_SCAN_UNROLL to
-    override. Default 1 EVERYWHERE: CPU-mesh measurements did not
-    survive re-measurement through the production path (a hand-built
-    kernel probe showed unroll=2 at 1.49× on a B=4 × 15.7k-event
-    launch, but the same shape through the bucketed production kernels
-    measured unroll=1 faster, 11.2 s vs 16.0 s — the round-3 lesson
-    about one-probe conclusions, again). Whether unroll buys anything
-    on the v5e scan (where per-step loop overhead, not FLOPs, is the
-    suspected wall) is exactly what the on-chip sweep answers.
-    Resolved at kernel-build time and part of the kernel-cache key."""
-    v = os.environ.get("JGRAFT_SCAN_UNROLL")
-    if v:
-        return max(1, int(v))
-    return 1
-
-
 def _bit_table(M: int, W: int) -> np.ndarray:
     """[M, W] static table: bit w of mask m."""
     return (np.arange(M)[:, None] >> np.arange(W)[None, :]) & 1
 
-
-def _closure_fixpoint(W: int, sweep, F, active):
-    """Iterate `sweep` (one pass over all slots) to the reachability
-    fixpoint. Each productive sweep extends every pending linearization
-    chain by ≥1 op and chains are ≤W long, so ≤W sweeps suffice; the
-    change test is exact even when the frontier representation holds
-    redundant entries (it compares the whole array). `active`
-    short-circuits non-FORCE events."""
-
-    def cond(c):
-        return c[0]
-
-    def body(c):
-        _, it, F = c
-        F0 = F
-        F = sweep(F)
-        return (jnp.any(F != F0) & (it < W), it + 1, F)
-
-    _, _, F = lax.while_loop(cond, body, (active, jnp.int32(0), F))
-    return F
-
-
-def _force_arith(F, slot_w):
-    """Switch-free FORCE dispatch (the ISSUE-4 "dense slot dispatch"
-    half): kill configurations missing the forced slot's bit, then
-    recycle the bit by moving the bit=1 half of the butterfly onto the
-    bit=0 half — both computed *arithmetically* from the dynamic slot id
-    (the same style as ops/linear_scan.py's bitvec math) instead of the
-    old `lax.switch` over W static branches, which under vmap lowered to
-    select-over-all-branches: every scan step paid W× the one taken
-    branch's [M, S] work. The down-shift by the dynamic bit weight is
-    one `lax.dynamic_slice` of a zero-extended copy — static shapes, no
-    reshape, no scatter; under vmap the batched start lowers to per-row
-    slices (re-ablate on chip if that regresses — both the macro and
-    the JGRAFT_MACRO_EVENTS=0 legacy stream share this dispatch, so the
-    macro A/B stays a pure stream-length comparison).
-
-    F: [M, S] bool (mask mode passes S=1); slot_w pre-clipped to
-    [0, W). Returns (F', any_survivor)."""
-    M, S = F.shape
-    ids = jnp.arange(M, dtype=jnp.int32)
-    has = ((ids >> slot_w) & 1) == 1            # [M] bit slot_w of m
-    Fk = F & has[:, None]
-    alive = jnp.any(Fk)
-    ext = jnp.concatenate([Fk, jnp.zeros_like(Fk)], axis=0)  # [2M, S]
-    shifted = lax.dynamic_slice(
-        ext, (jnp.int32(1) << slot_w, jnp.int32(0)), (M, S))
-    return jnp.where(has[:, None], False, shifted), alive
-
-
-def _macro_cols(row, macro_p: int):
-    """Split one macro-event row [3 + 4·P] (history/packing.py
-    macro_compact layout) into (mtype, force_slot, n_opens,
-    pslot [P], pf [P], pa [P], pb [P])."""
-    pay = row[3:3 + 4 * macro_p].reshape(macro_p, 4)
-    return (row[0], row[1], row[2],
-            pay[:, 0], pay[:, 1], pay[:, 2], pay[:, 3])
-
-
-def _macro_select(slot_ids, pslot, valid):
-    """Masked-scatter helpers for the vectorized multi-slot latch:
-    eq [W, P] marks which payload lands in which slot register (slots
-    within a macro are distinct — packing only recycles a slot at its
-    FORCE — so at most one payload matches per slot), upd [W] which
-    slots update at all."""
-    eq = (slot_ids[:, None] == pslot[None, :]) & valid[None, :]
-    return eq, eq.any(axis=1)
-
-
-def _macro_latch_i32(eq, upd, old, new):
-    """old [W] int32 register ← payload values new [P] where upd."""
-    return jnp.where(upd, (eq.astype(jnp.int32) * new[None, :]).sum(1),
-                     old)
-
-
-def macro_row_ints(macro_p: int = MACRO_MAX_OPENS) -> int:
-    """int32 lanes of one macro-event row: [mtype, force_slot, n_opens]
-    + macro_p × (slot, f, a, b); defaults to the widest row the encoder
-    can emit (the MACRO_MAX_OPENS cap). Pure arithmetic on purpose —
-    the kernel-contract analyzer (lint/flow/kernel_contract.py)
-    executes it statically at the cap to re-prove the chunk event slabs
-    and the Pallas lane-expanded block against the VMEM budgets."""
-    return 3 + 4 * macro_p
 
 
 def hoist_transitions() -> bool:
@@ -588,9 +485,9 @@ def dense_step_parts(model, n_slots: int, n_states: int,
 
         def style_macro_latch(extra, eq, upd, pf, pa, pb, val_of):
             sf, sa, sb = extra
-            return (_macro_latch_i32(eq, upd, sf, pf),
-                    _macro_latch_i32(eq, upd, sa, pa),
-                    _macro_latch_i32(eq, upd, sb, pb))
+            return (macro_latch_i32(eq, upd, sf, pf),
+                    macro_latch_i32(eq, upd, sa, pa),
+                    macro_latch_i32(eq, upd, sb, pb))
 
         def style_sweep(extra, slot_open, val_of):
             sf, sa, sb = extra
@@ -607,60 +504,46 @@ def dense_step_parts(model, n_slots: int, n_states: int,
 
             return sweep
 
-    def _force_phase(F, extra, slot_open, ok, dirty, val_of, is_force,
-                     slot):
+    # IR hooks (ops/kernel_ir.make_stream_step): the stream decode and
+    # latch-mask math live in the IR; only the dense state lowering —
+    # register/transition latch, the closure sweep, the frontier FORCE —
+    # is defined here.
+    def latch(carry, slot, f, a, b, is_open, upd):
+        F, extra, slot_open, ok, dirty, val_of = carry
+        extra = style_update(extra, upd, f, a, b, val_of)
+        slot_open = jnp.where(upd, True, slot_open)
+        dirty = dirty | is_open
+        return (F, extra, slot_open, ok, dirty, val_of)
+
+    def macro_latch(carry, pslot, pf, pa, pb, valid, n, eq, upd):
+        # Vectorized multi-slot latch: ≤P opens masked-scattered into
+        # the slot registers in one step.
+        F, extra, slot_open, ok, dirty, val_of = carry
+        extra = style_macro_latch(extra, eq, upd, pf, pa, pb, val_of)
+        slot_open = slot_open | upd
+        dirty = dirty | (n > 0)
+        return (F, extra, slot_open, ok, dirty, val_of)
+
+    def force_tail(carry, is_force, slot):
         """Shared closure+FORCE tail: identical for the legacy and
         macro streams (the whole soundness argument — the latch phases
-        reach the same registers, then run THIS same code)."""
-        F = _closure_fixpoint(W, style_sweep(extra, slot_open, val_of),
-                              F, is_force & dirty)
+        reach the same registers, then run THIS same code). Closure
+        runs only when an OPEN happened since the last one: a closed
+        frontier stays closed under FORCE kill+clear (extensions of a
+        surviving config are supersets, so they survived and cleared
+        too), so back-to-back completions skip the sweeps entirely."""
+        F, extra, slot_open, ok, dirty, val_of = carry
+        F = closure_fixpoint(W, style_sweep(extra, slot_open, val_of),
+                             F, is_force & dirty)
         dirty = dirty & ~is_force
-        F_forced, alive = _force_arith(F, jnp.clip(slot, 0, W - 1))
+        F_forced, alive = force_arith(F, jnp.clip(slot, 0, W - 1))
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
         slot_open = slot_open & ~((slot_ids == slot) & is_force)
-        return F, slot_open, ok, dirty
+        return (F, extra, slot_open, ok, dirty, val_of)
 
-    if macro_p is None:
-        def scan_step(carry, ev):
-            F, extra, slot_open, ok, dirty, val_of = carry
-            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
-            is_open = etype == EV_OPEN
-            is_force = etype == EV_FORCE
-
-            onehot = slot_ids == slot
-            upd = onehot & is_open
-            extra = style_update(extra, upd, f, a, b, val_of)
-            slot_open = jnp.where(upd, True, slot_open)
-            dirty = dirty | is_open
-
-            # Closure only when an OPEN happened since the last one: a
-            # closed frontier stays closed under FORCE kill+clear
-            # (extensions of a surviving config are supersets, so they
-            # survived and cleared too), so back-to-back completions
-            # skip the sweeps entirely.
-            F, slot_open, ok, dirty = _force_phase(
-                F, extra, slot_open, ok, dirty, val_of, is_force, slot)
-            return (F, extra, slot_open, ok, dirty, val_of), None
-    else:
-        P = int(macro_p)
-
-        def scan_step(carry, row):
-            F, extra, slot_open, ok, dirty, val_of = carry
-            mtype, fslot, n, pslot, pf, pa, pb = _macro_cols(row, P)
-            is_force = mtype == EV_FORCE
-
-            # Vectorized multi-slot latch: ≤P opens masked-scattered
-            # into the slot registers in one step.
-            eq, upd = _macro_select(slot_ids, pslot,
-                                    jnp.arange(P, dtype=jnp.int32) < n)
-            extra = style_macro_latch(extra, eq, upd, pf, pa, pb, val_of)
-            slot_open = slot_open | upd
-            dirty = dirty | (n > 0)
-
-            F, slot_open, ok, dirty = _force_phase(
-                F, extra, slot_open, ok, dirty, val_of, is_force, fslot)
-            return (F, extra, slot_open, ok, dirty, val_of), None
+    scan_step = make_stream_step(W, latch, macro_latch, force_tail,
+                                 macro_p)
 
     def init(val_of):
         F = jnp.zeros((M, S), dtype=bool).at[0, 0].set(True)
@@ -686,13 +569,8 @@ def make_dense_history_checker(model, n_slots: int, n_states: int,
     `dense_step_parts` for the kernel mechanics."""
     init, scan_step, verdict = dense_step_parts(model, n_slots, n_states,
                                                 hoist, macro_p)
-
-    def check(events, val_of):
-        carry, _ = lax.scan(scan_step, init(val_of), events,
-                            unroll=scan_unroll())
-        return verdict(carry)
-
-    return check
+    return monolithic_check(KernelParts(init, scan_step, verdict,
+                                        n_operands=1))
 
 
 def mask_step_parts(model, n_slots: int, macro_p: Optional[int] = None):
@@ -726,11 +604,11 @@ def mask_step_parts(model, n_slots: int, macro_p: Optional[int] = None):
         return jnp.concatenate([Fb[:, :1], grown[:, None]],
                                axis=1).reshape(M, 1)
 
-    def _force_phase(carry_tail, is_force, slot):
+    def force_tail(carry, is_force, slot):
         """Shared closure+FORCE tail (identical for legacy and macro
         streams; see dense_step_parts)."""
         (F, base, sums, slot_delta, slot_f, slot_a, slot_b, slot_open,
-         ok, dirty) = carry_tail
+         ok, dirty) = carry
         # Per-slot legality over ALL M config states at once: state and
         # slot registers are closure-invariant, so this lifts the
         # model.jax_step calls out of the fixpoint loop entirely (the
@@ -746,11 +624,11 @@ def mask_step_parts(model, n_slots: int, macro_p: Optional[int] = None):
             return F
 
         # Closure only when dirtied by an OPEN since the last closure
-        # (see the domain kernel's scan_step for why that is sound).
-        F = _closure_fixpoint(W, sweep, F, is_force & dirty)
+        # (see the domain kernel's force_tail for why that is sound).
+        F = closure_fixpoint(W, sweep, F, is_force & dirty)
         dirty = dirty & ~is_force
 
-        F_forced, alive = _force_arith(F, jnp.clip(slot, 0, W - 1))
+        F_forced, alive = force_arith(F, jnp.clip(slot, 0, W - 1))
         F = jnp.where(is_force, F_forced, F)
         ok = ok & (~is_force | alive)
         # Retire the forced op: its delta is now part of every
@@ -766,65 +644,49 @@ def mask_step_parts(model, n_slots: int, macro_p: Optional[int] = None):
         return (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
                 slot_open, ok, dirty)
 
-    if macro_p is None:
-        def scan_step(carry, ev):
-            (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
-             slot_open, ok, dirty) = carry
-            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
-            is_open = etype == EV_OPEN
-            is_force = etype == EV_FORCE
+    def latch(carry, slot, f, a, b, is_open, upd):
+        (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+         slot_open, ok, dirty) = carry
+        onehot = slot_ids == slot
+        slot_f = jnp.where(upd, f, slot_f)
+        slot_a = jnp.where(upd, a, slot_a)
+        slot_b = jnp.where(upd, b, slot_b)
+        slot_open = jnp.where(upd, True, slot_open)
+        dirty = dirty | is_open
+        # Maintain sums[m] = Σ_w bit_w(m) · slot_delta[w] as slot
+        # w's delta changes from its stale value to this op's.
+        col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)
+        old_d = jnp.sum(jnp.where(onehot, slot_delta, 0))
+        new_d = model.mask_delta(f, a, b)
+        sums = jnp.where(is_open, sums + col * (new_d - old_d), sums)
+        slot_delta = jnp.where(upd, new_d, slot_delta)
+        return (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+                slot_open, ok, dirty)
 
-            onehot = slot_ids == slot
-            upd = onehot & is_open
-            slot_f = jnp.where(upd, f, slot_f)
-            slot_a = jnp.where(upd, a, slot_a)
-            slot_b = jnp.where(upd, b, slot_b)
-            slot_open = jnp.where(upd, True, slot_open)
-            dirty = dirty | is_open
-            # Maintain sums[m] = Σ_w bit_w(m) · slot_delta[w] as slot
-            # w's delta changes from its stale value to this op's.
-            col = jnp.take(bit_i32, jnp.clip(slot, 0, W - 1), axis=1)
-            old_d = jnp.sum(jnp.where(onehot, slot_delta, 0))
-            new_d = model.mask_delta(f, a, b)
-            sums = jnp.where(is_open, sums + col * (new_d - old_d), sums)
-            slot_delta = jnp.where(upd, new_d, slot_delta)
+    def macro_latch(carry, pslot, pf, pa, pb, valid, n, eq, upd):
+        (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+         slot_open, ok, dirty) = carry
+        sel = eq.astype(jnp.int32)
+        # Pre-latch deltas of the opened slots (0 in practice — a
+        # recycled slot's delta was zeroed at its FORCE — but the
+        # legacy stream computes the general form, so mirror it).
+        old_d = (sel * slot_delta[:, None]).sum(0)           # [P]
+        new_d = jax.vmap(model.mask_delta)(pf, pa, pb)       # [P]
+        slot_f = macro_latch_i32(eq, upd, slot_f, pf)
+        slot_a = macro_latch_i32(eq, upd, slot_a, pa)
+        slot_b = macro_latch_i32(eq, upd, slot_b, pb)
+        slot_open = slot_open | upd
+        dirty = dirty | (n > 0)
+        cols = jnp.take(bit_i32, jnp.clip(pslot, 0, W - 1),
+                        axis=1)                              # [M, P]
+        sums = sums + (cols * jnp.where(valid, new_d - old_d,
+                                        0)[None, :]).sum(axis=1)
+        slot_delta = macro_latch_i32(eq, upd, slot_delta, new_d)
+        return (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
+                slot_open, ok, dirty)
 
-            carry = _force_phase(
-                (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
-                 slot_open, ok, dirty), is_force, slot)
-            return carry, None
-    else:
-        P = int(macro_p)
-
-        def scan_step(carry, row):
-            (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
-             slot_open, ok, dirty) = carry
-            mtype, fslot, n, pslot, pf, pa, pb = _macro_cols(row, P)
-            is_force = mtype == EV_FORCE
-
-            valid = jnp.arange(P, dtype=jnp.int32) < n
-            eq, upd = _macro_select(slot_ids, pslot, valid)
-            sel = eq.astype(jnp.int32)
-            # Pre-latch deltas of the opened slots (0 in practice — a
-            # recycled slot's delta was zeroed at its FORCE — but the
-            # legacy stream computes the general form, so mirror it).
-            old_d = (sel * slot_delta[:, None]).sum(0)           # [P]
-            new_d = jax.vmap(model.mask_delta)(pf, pa, pb)       # [P]
-            slot_f = _macro_latch_i32(eq, upd, slot_f, pf)
-            slot_a = _macro_latch_i32(eq, upd, slot_a, pa)
-            slot_b = _macro_latch_i32(eq, upd, slot_b, pb)
-            slot_open = slot_open | upd
-            dirty = dirty | (n > 0)
-            cols = jnp.take(bit_i32, jnp.clip(pslot, 0, W - 1),
-                            axis=1)                              # [M, P]
-            sums = sums + (cols * jnp.where(valid, new_d - old_d,
-                                            0)[None, :]).sum(axis=1)
-            slot_delta = _macro_latch_i32(eq, upd, slot_delta, new_d)
-
-            carry = _force_phase(
-                (F, base, sums, slot_delta, slot_f, slot_a, slot_b,
-                 slot_open, ok, dirty), is_force, fslot)
-            return carry, None
+    scan_step = make_stream_step(W, latch, macro_latch, force_tail,
+                                 macro_p)
 
     def init(val_of):
         del val_of  # calling-convention dummy (see docstring)
@@ -848,13 +710,8 @@ def make_mask_dense_history_checker(model, n_slots: int,
     """fn(events [E,5], val_of [1] ignored) -> (valid, False); see
     `mask_step_parts` for the kernel mechanics."""
     init, scan_step, verdict = mask_step_parts(model, n_slots, macro_p)
-
-    def check(events, val_of):
-        carry, _ = lax.scan(scan_step, init(val_of), events,
-                            unroll=scan_unroll())
-        return verdict(carry)
-
-    return check
+    return monolithic_check(KernelParts(init, scan_step, verdict,
+                                        n_operands=1))
 
 
 def make_dense_single_checker(model, kind: str, n_slots: int,
@@ -892,19 +749,6 @@ def make_dense_batch_checker(model, kind: str, n_slots: int, n_states: int,
             fn = jax.jit(fn)
         _KERNEL_CACHE[key] = fn
     return fn
-
-
-def dense_chunk_carry_bytes(n_slots: int, n_states: int) -> int:
-    """Conservative per-row resident bytes of the chunked domain carry:
-    frontier F [2^W, S] bool + hoisted transitions [W, S, S] bool + slot
-    registers + the events_left lane. Pure arithmetic on purpose — the
-    kernel-contract analyzer executes it statically at the eligibility
-    caps (lint/flow/kernel_contract.py) to pin the chunked entry points
-    to the same VMEM envelope as the monolithic kernels."""
-    return ((1 << n_slots) * n_states          # F
-            + n_slots * n_states * n_states    # hoisted T (worst style)
-            + 4 * n_slots * 4                  # slot registers (int32)
-            + 8)                               # ok/dirty/events_left
 
 
 def make_dense_chunk_checker(model, kind: str, n_slots: int, n_states: int,
@@ -956,49 +800,8 @@ def make_dense_chunk_checker(model, kind: str, n_slots: int, n_states: int,
                  else dense_step_parts(model, n_slots, n_states,
                                        macro_p=macro_p))
         init, scan_step, verdict = parts
-
-        def init_one(val_of, n_ev):
-            return {"inner": init(val_of),
-                    "left": jnp.asarray(n_ev, jnp.int32)}
-
-        def step_one(carry, events):
-            inner, _ = lax.scan(scan_step, carry["inner"], events,
-                                unroll=scan_unroll())
-            left = carry["left"] - events.shape[0]
-            ok, overflow = verdict(inner)
-            return ({"inner": inner, "left": left},
-                    ~ok, left <= 0, ok, overflow)
-
-        init_fn = jax.vmap(init_one)
-        step_fn = jax.vmap(step_one)
-        if mesh is not None:
-            init_fn, step_fn = _shard_chunk_fns(init_fn, step_fn, mesh,
-                                                n_init_args=2)
-        if jit:
-            init_fn = jax.jit(init_fn)
-            step_fn = jax.jit(step_fn)
-        fns = (init_fn, step_fn)
+        fns = batch_chunk_checker(
+            KernelParts(init, scan_step, verdict, n_operands=1),
+            mesh=mesh, jit=jit)
         _KERNEL_CACHE[key] = fns
     return fns
-
-
-def _shard_chunk_fns(init_fn, step_fn, mesh, n_init_args: int):
-    """Wrap a vmapped (init_fn, step_fn) chunk-kernel pair in
-    `shard_map` over the batch axis of `mesh`. P(axis) acts as a pytree
-    prefix over the carry dict (every leaf is batch-leading), and the
-    replication check is off for the same reason as the monolithic
-    sharded checkers: the computation is per-shard independent by
-    construction (parallel/mesh.py). Lazy import — parallel.mesh
-    imports this module at load time."""
-    from jax.sharding import PartitionSpec as P
-
-    from ..parallel.mesh import _SHARD_MAP_CHECK_KW, shard_map
-
-    spec = P(mesh.axis_names[0])
-    init_sm = shard_map(init_fn, mesh=mesh,
-                        in_specs=(spec,) * n_init_args, out_specs=spec,
-                        **{_SHARD_MAP_CHECK_KW: False})
-    step_sm = shard_map(step_fn, mesh=mesh, in_specs=(spec, spec),
-                        out_specs=(spec,) * 5,
-                        **{_SHARD_MAP_CHECK_KW: False})
-    return init_sm, step_sm

@@ -1,0 +1,370 @@
+"""Unified kernel IR: the one step-parts substrate every scan family
+instantiates.
+
+Before this module existed, every performance feature grew 4× by hand:
+chunking (ISSUE 3) and macro-event compaction (ISSUE 4) were each ported
+separately into the dense/mask kernels (ops/dense_scan.py), the sort
+ladder (ops/linear_scan.py), the segment kernel and the Pallas tile
+kernel — four copies of the event-row decode, the macro-latch
+application, the arithmetic FORCE dispatch, the chunk-carry schema and
+the decided/exhausted flag semantics. This module is the single home of
+that shared machinery; each family now keeps ONLY its state-
+representation lowering (how a frontier is stored and swept) and plugs
+it into the IR through three hooks.
+
+The IR's contract — what a family must supply (doc/checker-design.md §9):
+
+  ``latch(carry, slot, f, a, b, is_open, upd) -> carry``
+      Latch ONE op's registers (legacy one-event-per-step stream).
+      ``upd`` is the precomputed per-slot write mask
+      ``(slot_ids == slot) & is_open``.
+  ``macro_latch(carry, pslot, pf, pa, pb, valid, n, eq, upd) -> carry``
+      Latch ≤P opens at once (macro stream, history/packing.py
+      macro_compact). ``eq``/``upd`` come from :func:`macro_select`;
+      slots within a macro are distinct (packing only recycles a slot
+      at its FORCE), so at most one payload matches per slot.
+  ``force_tail(carry, is_force, slot) -> carry``
+      The closure + FORCE phase. Identical for both streams — this is
+      the whole macro soundness argument: the latch phases reach the
+      same pre-FORCE register state, then run THIS same code, and
+      closure is a reachability fixpoint over exactly those registers,
+      so verdicts are bitwise-identical (pinned by
+      tests/test_macro_events.py and tests/test_kernel_ir.py).
+
+:func:`make_stream_step` assembles the hooks into the per-event
+``scan_step``; :class:`KernelParts` bundles (init, scan_step, verdict);
+:func:`monolithic_check` and :func:`batch_chunk_checker` are the two
+drivers (one step body, two drivers — the chunked wavefront of
+checker/schedule.py can never diverge semantically from the reference
+scan). The chunk-carry schema ({"inner", "left"}) and the
+decided/exhausted eviction flags are defined here ONCE; their soundness
+argument (``ok`` is monotone, a dead frontier stays dead, an exhausted
+row only has EV_PAD no-ops left) is restated at :func:`chunk_step_fns`.
+
+The eligibility caps and the chunk-carry byte accounting live here too:
+the graftcheck kernel-contract analyzer (lint/flow/kernel_contract.py)
+proves the VMEM budgets ONCE against this module instead of per family.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..history.packing import EV_FORCE, EV_OPEN, MACRO_MAX_OPENS
+
+# --------------------------------------------------------------- caps
+# Family eligibility caps (moved here from the per-family modules so the
+# kernel-contract analyzer samples every family's chunk-carry budget
+# against ONE module). The families re-import and re-export them, so the
+# routing layers keep their existing spellings.
+
+#: Dense-domain caps. Per-event work is ~W · 2^W · S² (closure sweeps)
+#: plus 2^W · S (the arithmetic FORCE path), so the dense path is
+#: reserved for genuinely small problems — which the reference's own
+#: workload shapes are (window ≈ n_procs, domain ≈ 5 values; a few
+#: crashed ops' never-retiring slots push long histories to W ≈ 10).
+DENSE_MAX_SLOTS = 10
+DENSE_MAX_STATES = 16
+DENSE_MAX_CELLS = 8192  # 2^W · S
+
+#: Mask mode has no state dimension (S² → 1), so it affords a wider
+#: window: 2^12 bool cells + an int32 subset-sum lane per history.
+MASK_DENSE_MAX_SLOTS = 12
+
+#: Sort-ladder caps (ops/linear_scan.py re-exports these under its
+#: historical names MAX_SLOTS / DEFAULT_N_CONFIGS). The window cap is
+#: 4 mask words with a spare top bit for the all-ones empty-entry
+#: sentinel — linear_scan's contract pins it.
+SORT_MAX_SLOTS = 127
+SORT_DEFAULT_CONFIGS = 256
+
+
+def scan_unroll() -> int:
+    """Events per lax.scan step across the event-scan kernels (dense,
+    mask, segment, sort) — an ablation knob for the on-chip sweep
+    (scripts/calibrate_routing.py --unroll), JGRAFT_SCAN_UNROLL to
+    override. Default 1 EVERYWHERE: CPU-mesh measurements did not
+    survive re-measurement through the production path (a hand-built
+    kernel probe showed unroll=2 at 1.49× on a B=4 × 15.7k-event
+    launch, but the same shape through the bucketed production kernels
+    measured unroll=1 faster, 11.2 s vs 16.0 s — the round-3 lesson
+    about one-probe conclusions, again). Resolved at kernel-build time
+    and part of every kernel-cache key."""
+    v = os.environ.get("JGRAFT_SCAN_UNROLL")
+    if v:
+        return max(1, int(v))
+    return 1
+
+
+# ------------------------------------------------------- event-row layout
+
+
+def macro_row_ints(macro_p: int = MACRO_MAX_OPENS) -> int:
+    """int32 lanes of one macro-event row: [mtype, force_slot, n_opens]
+    + macro_p × (slot, f, a, b); defaults to the widest row the encoder
+    can emit (the MACRO_MAX_OPENS cap). Pure arithmetic on purpose —
+    the kernel-contract analyzer (lint/flow/kernel_contract.py)
+    executes it statically at the cap to re-prove the chunk event slabs
+    and the Pallas lane-expanded block against the VMEM budgets."""
+    return 3 + 4 * macro_p
+
+
+def macro_cols(row, macro_p: int):
+    """Split one macro-event row [3 + 4·P] (history/packing.py
+    macro_compact layout) into (mtype, force_slot, n_opens,
+    pslot [P], pf [P], pa [P], pb [P])."""
+    pay = row[3:3 + 4 * macro_p].reshape(macro_p, 4)
+    return (row[0], row[1], row[2],
+            pay[:, 0], pay[:, 1], pay[:, 2], pay[:, 3])
+
+
+def macro_select(slot_ids, pslot, valid):
+    """Masked-scatter helpers for the vectorized multi-slot latch:
+    eq [W, P] marks which payload lands in which slot register (slots
+    within a macro are distinct — packing only recycles a slot at its
+    FORCE — so at most one payload matches per slot), upd [W] which
+    slots update at all."""
+    eq = (slot_ids[:, None] == pslot[None, :]) & valid[None, :]
+    return eq, eq.any(axis=1)
+
+
+def macro_latch_i32(eq, upd, old, new):
+    """old [W] int32 register ← payload values new [P] where upd."""
+    return jnp.where(upd, (eq.astype(jnp.int32) * new[None, :]).sum(1),
+                     old)
+
+
+# --------------------------------------------------- shared FORCE/closure
+
+
+def closure_fixpoint(W: int, sweep, F, active):
+    """Iterate `sweep` (one pass over all slots) to the reachability
+    fixpoint. Each productive sweep extends every pending linearization
+    chain by ≥1 op and chains are ≤W long, so ≤W sweeps suffice; the
+    change test is exact even when the frontier representation holds
+    redundant entries (it compares the whole array). `active`
+    short-circuits non-FORCE events."""
+
+    def cond(c):
+        return c[0]
+
+    def body(c):
+        _, it, F = c
+        F0 = F
+        F = sweep(F)
+        return (jnp.any(F != F0) & (it < W), it + 1, F)
+
+    _, _, F = lax.while_loop(cond, body, (active, jnp.int32(0), F))
+    return F
+
+
+def force_arith(F, slot_w):
+    """Switch-free FORCE dispatch over a dense frontier (the ISSUE-4
+    "dense slot dispatch" half): kill configurations missing the forced
+    slot's bit, then recycle the bit by moving the bit=1 half of the
+    butterfly onto the bit=0 half — both computed *arithmetically* from
+    the dynamic slot id (the same style as the sort kernel's bitvec
+    math) instead of the old `lax.switch` over W static branches, which
+    under vmap lowered to select-over-all-branches: every scan step
+    paid W× the one taken branch's [M, S] work. The down-shift by the
+    dynamic bit weight is one `lax.dynamic_slice` of a zero-extended
+    copy — static shapes, no reshape, no scatter; under vmap the
+    batched start lowers to per-row slices (re-ablate on chip if that
+    regresses — both the macro and the JGRAFT_MACRO_EVENTS=0 legacy
+    stream share this dispatch, so the macro A/B stays a pure
+    stream-length comparison).
+
+    F: [M, S] bool (mask mode passes S=1); slot_w pre-clipped to
+    [0, W). Returns (F', any_survivor)."""
+    M, S = F.shape
+    ids = jnp.arange(M, dtype=jnp.int32)
+    has = ((ids >> slot_w) & 1) == 1            # [M] bit slot_w of m
+    Fk = F & has[:, None]
+    alive = jnp.any(Fk)
+    ext = jnp.concatenate([Fk, jnp.zeros_like(Fk)], axis=0)  # [2M, S]
+    shifted = lax.dynamic_slice(
+        ext, (jnp.int32(1) << slot_w, jnp.int32(0)), (M, S))
+    return jnp.where(has[:, None], False, shifted), alive
+
+
+# ---------------------------------------------------------- stream step
+
+
+def make_stream_step(n_slots: int, latch: Callable, macro_latch: Callable,
+                     force_tail: Callable,
+                     macro_p: Optional[int] = None) -> Callable:
+    """The single definition of the per-event scan body every family
+    shares: decode the event row (legacy [5] or macro [3 + 4·P]),
+    compute the latch write masks, call the family's latch hook, then
+    the family's closure+FORCE tail. This is where the old per-family
+    ``if macro_p is None: ... else: ...`` twins collapsed to — a stream
+    format change now happens in exactly one place.
+
+    See the module docstring for the hook signatures; `n_slots` fixes
+    the kernel's W (the hooks close over their own W-shaped state)."""
+    slot_ids = jnp.arange(int(n_slots), dtype=jnp.int32)
+    if macro_p is None:
+        def scan_step(carry, ev):
+            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
+            is_open = etype == EV_OPEN
+            is_force = etype == EV_FORCE
+            upd = (slot_ids == slot) & is_open
+            carry = latch(carry, slot, f, a, b, is_open, upd)
+            carry = force_tail(carry, is_force, slot)
+            return carry, None
+    else:
+        P = int(macro_p)
+
+        def scan_step(carry, row):
+            mtype, fslot, n, pslot, pf, pa, pb = macro_cols(row, P)
+            is_force = mtype == EV_FORCE
+            valid = jnp.arange(P, dtype=jnp.int32) < n
+            eq, upd = macro_select(slot_ids, pslot, valid)
+            carry = macro_latch(carry, pslot, pf, pa, pb, valid, n, eq,
+                                upd)
+            carry = force_tail(carry, is_force, fslot)
+            return carry, None
+    return scan_step
+
+
+# -------------------------------------------------------------- drivers
+
+
+@dataclass(frozen=True)
+class KernelParts:
+    """A family's lowered step parts, ready for either driver.
+
+    init:      init(*operands) -> per-row scan carry (``n_operands``
+               per-row operands, e.g. the dense kernels' val_of table;
+               the sort kernel takes none).
+    scan_step: the per-event body (from :func:`make_stream_step`).
+    verdict:   carry -> (ok, overflow).
+    """
+
+    init: Callable
+    scan_step: Callable
+    verdict: Callable
+    n_operands: int = 0
+
+
+def monolithic_check(parts: KernelParts) -> Callable:
+    """The reference driver: fn(events [E, R], *operands) ->
+    (ok, overflow) — one `lax.scan` over the whole stream."""
+    def check(events, *operands):
+        carry, _ = lax.scan(parts.scan_step, parts.init(*operands),
+                            events, unroll=scan_unroll())
+        return parts.verdict(carry)
+
+    return check
+
+
+def chunk_step_fns(parts: KernelParts):
+    """The chunk-carry schema + decided/exhausted flag semantics, in
+    one place (this used to be duplicated between the dense and sort
+    chunk builders). Returns single-row (init_one, step_one):
+
+      init_one(*operands, n_ev) -> {"inner": scan carry, "left": int32}
+      step_one(carry, events [chunk, R]) -> (carry', decided,
+          exhausted, ok, overflow)
+
+    Eviction soundness (the checker/linearizable.py contract): `ok` is
+    monotone — it only ever ANDs in new conditions — and flips False
+    exactly when the frontier dies, after which every event is a no-op
+    on the dead frontier, so a `decided` (= ~ok) row's (ok, overflow)
+    pair is frozen mid-scan. An `exhausted` row (events_left ≤ 0) only
+    has EV_PAD no-ops left, so its current pair is final too. Either
+    flag makes the row safe to evict: eviction only ever removes rows
+    whose verdict is certain. Chaining step_one over E/chunk chunks
+    applies the identical scan_step sequence as the monolithic
+    `lax.scan`, so verdicts are bitwise-identical by construction
+    (pinned by the tests/test_kernel_ir.py differentials)."""
+    def init_one(*args):
+        operands, n_ev = args[:-1], args[-1]
+        return {"inner": parts.init(*operands),
+                "left": jnp.asarray(n_ev, jnp.int32)}
+
+    def step_one(carry, events):
+        inner, _ = lax.scan(parts.scan_step, carry["inner"], events,
+                            unroll=scan_unroll())
+        left = carry["left"] - events.shape[0]
+        ok, overflow = parts.verdict(inner)
+        return ({"inner": inner, "left": left},
+                ~ok, left <= 0, ok, overflow)
+
+    return init_one, step_one
+
+
+def batch_chunk_checker(parts: KernelParts, mesh=None, jit: bool = True):
+    """Batch driver for the wavefront scheduler (checker/schedule.py):
+    vmapped (init_fn, step_fn) over the batch axis, optionally wrapped
+    in an explicit `shard_map` over `mesh` (see :func:`shard_chunk_fns`
+    — relying on jit's GSPMD sharding propagation *placed* the carry
+    sharded but compiled a ~3× slower per-chunk program than the
+    explicit wrap on the CPU mesh). Callers pad the batch to a multiple
+    of the mesh size (schedule._bucket_launch_rows)."""
+    init_one, step_one = chunk_step_fns(parts)
+    init_fn = jax.vmap(init_one)
+    step_fn = jax.vmap(step_one)
+    if mesh is not None:
+        init_fn, step_fn = shard_chunk_fns(
+            init_fn, step_fn, mesh, n_init_args=parts.n_operands + 1)
+    if jit:
+        init_fn = jax.jit(init_fn)
+        step_fn = jax.jit(step_fn)
+    return init_fn, step_fn
+
+
+def shard_chunk_fns(init_fn, step_fn, mesh, n_init_args: int):
+    """Wrap a vmapped (init_fn, step_fn) chunk-kernel pair in
+    `shard_map` over the batch axis of `mesh`. P(axis) acts as a pytree
+    prefix over the carry dict (every leaf is batch-leading), and the
+    replication check is off for the same reason as the monolithic
+    sharded checkers: the computation is per-shard independent by
+    construction (parallel/mesh.py). Lazy import — parallel.mesh
+    imports the ops package at load time."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import _SHARD_MAP_CHECK_KW, shard_map
+
+    spec = P(mesh.axis_names[0])
+    init_sm = shard_map(init_fn, mesh=mesh,
+                        in_specs=(spec,) * n_init_args, out_specs=spec,
+                        **{_SHARD_MAP_CHECK_KW: False})
+    step_sm = shard_map(step_fn, mesh=mesh, in_specs=(spec, spec),
+                        out_specs=(spec,) * 5,
+                        **{_SHARD_MAP_CHECK_KW: False})
+    return init_sm, step_sm
+
+
+# ----------------------------------------------------- contract bindings
+# Conservative per-row resident bytes of each family's chunked carry.
+# Pure arithmetic on purpose: the graftcheck kernel-contract analyzer
+# (lint/flow/kernel_contract.py) executes these statically at the cap
+# corners above — ONE set of bindings for every family that chunks
+# through the IR, replacing the per-family duplicates.
+
+
+def dense_chunk_carry_bytes(n_slots: int, n_states: int) -> int:
+    """Chunked domain/mask carry: frontier F [2^W, S] bool + hoisted
+    transitions [W, S, S] bool (worst style) + slot registers + the
+    events_left lane. Mask mode runs at S=1; its subset-sum lane is
+    covered by the conservative register term."""
+    return ((1 << n_slots) * n_states          # F
+            + n_slots * n_states * n_states    # hoisted T (worst style)
+            + 4 * n_slots * 4                  # slot registers (int32)
+            + 8)                               # ok/dirty/events_left
+
+
+def sort_chunk_carry_bytes(n_configs: int, n_slots: int) -> int:
+    """Chunked sort carry: masks [C, K] uint32 + states [C] int32 +
+    slot registers + flags + the events_left lane."""
+    k = n_slots // 32 + 1
+    return (n_configs * k * 4 + n_configs * 4   # masks + states
+            + 3 * n_slots * 4 + n_slots         # slot regs + open
+            + 8)                                # ok/overflow/dirty/left

@@ -64,14 +64,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..history.packing import EV_FORCE, EV_OPEN
-from .dense_scan import (_macro_cols, _macro_latch_i32, _macro_select,
-                         scan_unroll)
-
-#: Hard window cap (4 mask words). Histories needing more concurrent slots
-#: (incl. never-retiring info ops) fall back to the CPU checker, whose
-#: masks are arbitrary-precision.
-MAX_SLOTS = 127
+# The shared step-parts substrate (PR 6 tentpole, ops/kernel_ir.py):
+# this module keeps only the sort-frontier state lowering; the stream
+# decode, macro latch helpers, chunk-carry schema and both drivers are
+# the IR's. The caps re-export under their historical names: the hard
+# window cap is 4 mask words with a spare top bit for the all-ones
+# empty-entry sentinel (histories needing more concurrent slots fall
+# back to the CPU checker, whose masks are arbitrary-precision).
+from .kernel_ir import SORT_DEFAULT_CONFIGS as DEFAULT_N_CONFIGS
+from .kernel_ir import SORT_MAX_SLOTS as MAX_SLOTS
+from .kernel_ir import (KernelParts, batch_chunk_checker, macro_latch_i32,
+                        make_stream_step, monolithic_check, scan_unroll)
+from .kernel_ir import sort_chunk_carry_bytes  # noqa: F401  (re-export)
 
 #: Windows ≤ SLOT_EXACT_MAX compile at their exact size — per-event closure
 #: work is linear in C×W, and typical windows (≤ n_procs, e.g. 5) are far
@@ -82,8 +86,6 @@ SLOT_EXACT_MAX = 16
 #: Bucket rungs above SLOT_EXACT_MAX: word-boundary maxima (32k-1 slots for
 #: k mask words). check_histories buckets each batch's real window up.
 SLOT_BUCKETS = (31, 63, 95, 127)
-
-DEFAULT_N_CONFIGS = 256
 
 # Empty-frontier-entry sentinel mask word. A NumPy (not jnp) scalar on
 # purpose: a module-level jnp constant would initialize the JAX backend at
@@ -206,7 +208,7 @@ def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
         )
         return masks, states, overflow
 
-    def _force_phase(carry, is_force, slot):
+    def force_tail(carry, is_force, slot):
         """Shared closure+FORCE tail — identical for the legacy and
         macro streams (the latch phases reach the same registers)."""
         (masks, states, slot_f, slot_a, slot_b, slot_open, ok, overflow,
@@ -245,47 +247,33 @@ def sort_step_parts(model, n_configs: int = DEFAULT_N_CONFIGS,
         return (cleared_m, states, slot_f, slot_a, slot_b, slot_open,
                 ok, overflow, dirty)
 
-    if macro_p is None:
-        def scan_step(carry, ev):
-            (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
-             overflow, dirty) = carry
-            etype, slot, f, a, b = ev[0], ev[1], ev[2], ev[3], ev[4]
-            is_open = etype == EV_OPEN
-            is_force = etype == EV_FORCE
+    # IR hooks (ops/kernel_ir.make_stream_step): only the sort-frontier
+    # register lowering lives here; decode + latch masks are the IR's.
+    def latch(carry, slot, f, a, b, is_open, upd):
+        (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+         overflow, dirty) = carry
+        slot_f = jnp.where(upd, f, slot_f)
+        slot_a = jnp.where(upd, a, slot_a)
+        slot_b = jnp.where(upd, b, slot_b)
+        slot_open = jnp.where(upd, True, slot_open)
+        dirty = dirty | is_open
+        return (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+                overflow, dirty)
 
-            upd = (slot_ids == slot) & is_open
-            slot_f = jnp.where(upd, f, slot_f)
-            slot_a = jnp.where(upd, a, slot_a)
-            slot_b = jnp.where(upd, b, slot_b)
-            slot_open = jnp.where(upd, True, slot_open)
-            dirty = dirty | is_open
+    def macro_latch(carry, pslot, pf, pa, pb, valid, n, eq, upd):
+        # Vectorized multi-slot latch (≤P opens, distinct slots).
+        (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+         overflow, dirty) = carry
+        slot_f = macro_latch_i32(eq, upd, slot_f, pf)
+        slot_a = macro_latch_i32(eq, upd, slot_a, pa)
+        slot_b = macro_latch_i32(eq, upd, slot_b, pb)
+        slot_open = slot_open | upd
+        dirty = dirty | (n > 0)
+        return (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
+                overflow, dirty)
 
-            carry = _force_phase(
-                (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
-                 overflow, dirty), is_force, slot)
-            return carry, None
-    else:
-        P = int(macro_p)
-
-        def scan_step(carry, row):
-            (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
-             overflow, dirty) = carry
-            mtype, fslot, n, pslot, pf, pa, pb = _macro_cols(row, P)
-            is_force = mtype == EV_FORCE
-
-            # Vectorized multi-slot latch (≤P opens, distinct slots).
-            eq, upd = _macro_select(slot_ids, pslot,
-                                    jnp.arange(P, dtype=jnp.int32) < n)
-            slot_f = _macro_latch_i32(eq, upd, slot_f, pf)
-            slot_a = _macro_latch_i32(eq, upd, slot_a, pa)
-            slot_b = _macro_latch_i32(eq, upd, slot_b, pb)
-            slot_open = slot_open | upd
-            dirty = dirty | (n > 0)
-
-            carry = _force_phase(
-                (masks, states, slot_f, slot_a, slot_b, slot_open, ok,
-                 overflow, dirty), is_force, fslot)
-            return carry, None
+    scan_step = make_stream_step(W, latch, macro_latch, force_tail,
+                                 macro_p)
 
     def init():
         masks = jnp.full((C, K), _SENT, dtype=jnp.uint32).at[0].set(
@@ -317,13 +305,7 @@ def make_history_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
     """
     init, scan_step, verdict = sort_step_parts(model, n_configs, n_slots,
                                                macro_p)
-
-    def check(events):
-        carry, _ = lax.scan(scan_step, init(), events,
-                            unroll=scan_unroll())
-        return verdict(carry)
-
-    return check
+    return monolithic_check(KernelParts(init, scan_step, verdict))
 
 
 def bucket_slots(n: int) -> int:
@@ -366,18 +348,6 @@ def make_batch_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
     return fn
 
 
-def sort_chunk_carry_bytes(n_configs: int, n_slots: int) -> int:
-    """Conservative per-row resident bytes of the chunked sort carry:
-    masks [C, K] uint32 + states [C] int32 + slot registers + flags +
-    the events_left lane. Pure arithmetic — executed statically by the
-    kernel-contract analyzer at (DEFAULT_N_CONFIGS, MAX_SLOTS) to pin
-    the chunked entry point's VMEM envelope."""
-    k = n_slots // 32 + 1
-    return (n_configs * k * 4 + n_configs * 4   # masks + states
-            + 3 * n_slots * 4 + n_slots         # slot regs + open
-            + 8)                                # ok/overflow/dirty/left
-
-
 def make_sort_chunk_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
                             n_slots: int = MAX_SLOTS, jit: bool = True,
                             mesh=None, macro_p: Optional[int] = None):
@@ -409,29 +379,7 @@ def make_sort_chunk_checker(model, n_configs: int = DEFAULT_N_CONFIGS,
     if fns is None:
         init, scan_step, verdict = sort_step_parts(model, n_configs,
                                                    n_slots, macro_p)
-
-        def init_one(n_ev):
-            return {"inner": init(),
-                    "left": jnp.asarray(n_ev, jnp.int32)}
-
-        def step_one(carry, events):
-            inner, _ = lax.scan(scan_step, carry["inner"], events,
-                                unroll=scan_unroll())
-            left = carry["left"] - events.shape[0]
-            ok, overflow = verdict(inner)
-            return ({"inner": inner, "left": left},
-                    ~ok, left <= 0, ok, overflow)
-
-        init_fn = jax.vmap(init_one)
-        step_fn = jax.vmap(step_one)
-        if mesh is not None:
-            from .dense_scan import _shard_chunk_fns
-
-            init_fn, step_fn = _shard_chunk_fns(init_fn, step_fn, mesh,
-                                                n_init_args=1)
-        if jit:
-            init_fn = jax.jit(init_fn)
-            step_fn = jax.jit(step_fn)
-        fns = (init_fn, step_fn)
+        fns = batch_chunk_checker(KernelParts(init, scan_step, verdict),
+                                  mesh=mesh, jit=jit)
         _KERNEL_CACHE[key] = fns
     return fns

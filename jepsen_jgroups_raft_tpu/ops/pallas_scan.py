@@ -68,7 +68,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..history.packing import EV_FORCE, EV_OPEN
-from .dense_scan import macro_row_ints
+from .kernel_ir import macro_row_ints
 
 #: Lane budget: T·S targets the 128-lane vector axis.
 _LANE_TARGET = 128

@@ -55,9 +55,13 @@ import numpy as np
 from jax import lax
 
 from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
-from .dense_scan import (DENSE_MAX_CELLS, DENSE_MAX_SLOTS, DENSE_MAX_STATES,
-                         _closure_fixpoint, _force_arith, _pad_domains,
-                         scan_unroll)
+from .dense_scan import _pad_domains
+# Caps and the shared closure/FORCE machinery come straight from the
+# kernel IR (not via dense_scan re-exports): the kernel-contract
+# analyzer resolves this module's cap expressions by loading the
+# sibling the import names, and it does not chase re-export chains.
+from .kernel_ir import (DENSE_MAX_CELLS, DENSE_MAX_SLOTS, DENSE_MAX_STATES,
+                        closure_fixpoint, force_arith, scan_unroll)
 
 #: Segment the stream only when it is long enough to be worth the basis
 #: overhead; shorter histories take the plain dense kernel.
@@ -225,12 +229,12 @@ def make_segment_kernel(model, n_slots: int, n_states: int, n_events: int):
                 F = expand_w(w, F, Te)
             return F
 
-        F = _closure_fixpoint(W, sweep, F, is_force & dirty)
+        F = closure_fixpoint(W, sweep, F, is_force & dirty)
         dirty = dirty & ~is_force
 
-        # Switch-free dispatch (ops/dense_scan._force_arith): the old
+        # Switch-free dispatch (ops/kernel_ir.force_arith): the old
         # lax.switch evaluated all W branches under the segment vmap.
-        F_forced, _ = _force_arith(F, jnp.clip(slot, 0, W - 1))
+        F_forced, _ = force_arith(F, jnp.clip(slot, 0, W - 1))
         F = jnp.where(is_force, F_forced, F)
         slot_open = slot_open & ~(onehot & is_force)
         return (F, T, slot_open, dirty, val_of), None

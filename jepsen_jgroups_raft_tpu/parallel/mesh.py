@@ -72,7 +72,7 @@ def launch_fan_out() -> bool:
     return os.environ.get("JGRAFT_GROUP_DEVICES") != "0"
 
 
-def chunk_sharding():
+def chunk_sharding(n_devices: Optional[int] = None):
     """Batch-axis `NamedSharding` for the chunked wavefront scheduler's
     per-launch arrays (checker/schedule.py), spanning every default-
     backend device — or None (default single-device placement) when
@@ -91,13 +91,22 @@ def chunk_sharding():
     host-platform device split — 8 vdevs sharing 2 physical cores) a
     snugger mesh buys the same core parallelism at a fraction of the
     per-launch overhead. 0 disables fan-out entirely; 1 is clamped to
-    single-device placement (None)."""
+    single-device placement (None).
+
+    `n_devices` is the per-launch override the autotuner uses
+    (checker/autotune.py `mesh_fanout`): it caps the mesh like the env
+    knob but per call, so two window groups of one batch can fan out
+    differently. The env knob still applies as the outer bound — an
+    operator pinning JGRAFT_GROUP_DEVICES=0 must never get fanned-out
+    launches from a stale persisted plan."""
     from ..platform import env_int
 
     if not launch_fan_out():
         return None
     devs = jax.devices()
     cap = env_int("JGRAFT_GROUP_DEVICES", len(devs), minimum=0)
+    if n_devices is not None:
+        cap = min(cap, max(int(n_devices), 0))
     devs = devs[:max(cap, 1)]
     if len(devs) < 2:
         return None

@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..checker import autotune
 from ..checker.schedule import stats_scope
 from ..history.packing import bucket_rows
 from ..platform import env_int, is_backend_init_failure, note_degraded
@@ -205,6 +206,13 @@ class BatchScheduler:
         algorithm = live[0].algorithm
         label = "graftd:" + ",".join(r.id for r in live)
         degraded_note_local = None
+        # Autotune consult marker (PR 6): the checker applies per-bucket
+        # plans inside check_encoded; snapshot the applied-plan SEQUENCE
+        # (not the bounded log's length — that pins at the bound once
+        # trimming starts) so this batch's requests stamp exactly the
+        # plans their launch used (the worker is single-threaded, so
+        # everything after the mark is this batch's).
+        autotune_mark = autotune.applied_seq()
         t0 = time.monotonic()
         with stats_scope(label=label) as scan:
             try:
@@ -235,6 +243,7 @@ class BatchScheduler:
                     res["platform-degraded"] = degraded_note_local
         wall = time.monotonic() - t0
         scan_counters = {k: v for k, v in scan.items() if k != "label"}
+        autotune_plans = autotune.applied_since(autotune_mark)
         cursor = 0
         for r in live:
             mine = results[cursor:cursor + r.n_rows]
@@ -245,6 +254,7 @@ class BatchScheduler:
                 "batch_seq": seq,
                 "batch_wall_s": round(wall, 4),
                 "scan": dict(scan_counters, label=label),
+                "autotune_plans": autotune_plans,
                 "degraded": degraded_note_local is not None,
             }
             if r.cancelled.is_set():

@@ -165,42 +165,46 @@ class TestKernelContract:
         assert kernel_contract.analyze_source(src) == []
 
     def test_production_shape_files_clean(self):
-        for f in ("ops/dense_scan.py", "ops/linear_scan.py",
-                  "ops/segment_scan.py", "parallel/mesh.py"):
+        for f in ("ops/kernel_ir.py", "ops/dense_scan.py",
+                  "ops/linear_scan.py", "ops/segment_scan.py",
+                  "parallel/mesh.py"):
             src = SourceFile.load(PKG / Path(f))
             assert kernel_contract.analyze_source(src) == [], f
 
     def test_chunked_dense_carry_contract_fires_on_inflated_carry(self):
-        # ISSUE-3 binding: the chunked kernels keep per-row scan state
-        # resident BETWEEN launches; inflating the carry accounting past
-        # VMEM at the eligibility caps must fail the gate.
-        text = (PKG / "ops" / "dense_scan.py").read_text()
+        # ISSUE-3 binding, now proven ONCE against the kernel IR (PR 6):
+        # the chunked kernels keep per-row scan state resident BETWEEN
+        # launches; inflating the carry accounting past VMEM at the
+        # eligibility caps must fail the gate.
+        text = (PKG / "ops" / "kernel_ir.py").read_text()
         assert "(1 << n_slots) * n_states" in text
         mutated = text.replace("(1 << n_slots) * n_states          # F",
                                "(1 << n_slots) * n_states * 4096   # F")
-        found = kc(mutated, path="ops/dense_scan.py")
+        found = kc(mutated, path="ops/kernel_ir.py")
         assert "kernel-vmem-budget" in rules_of(found)
 
     def test_chunked_sort_carry_contract_fires_on_inflated_carry(self):
-        text = (PKG / "ops" / "linear_scan.py").read_text()
+        text = (PKG / "ops" / "kernel_ir.py").read_text()
         assert "n_configs * k * 4 + n_configs * 4" in text
         mutated = text.replace("n_configs * k * 4 + n_configs * 4",
                                "n_configs * k * 4096 + n_configs * 4")
-        found = kc(mutated, path="ops/linear_scan.py")
+        found = kc(mutated, path="ops/kernel_ir.py")
         assert "kernel-vmem-budget" in rules_of(found)
 
     def test_chunk_carry_binding_is_loud_when_fn_vanishes(self):
         # Renaming the accounting fn must FAIL the gate (loud), not
-        # silently drop the chunked-carry invariant.
-        text = (PKG / "ops" / "dense_scan.py").read_text()
-        mutated = text.replace("def dense_chunk_carry_bytes",
-                               "def renamed_carry_bytes")
-        found = kc(mutated, path="ops/dense_scan.py")
-        # The loud path must surface under kernel-unresolved (NOT
-        # kernel-vmem-budget): a baselined budget rule must never
-        # swallow a vanished accounting fn.
-        assert any(f.rule == "kernel-unresolved"
-                   and "not resolvable" in f.message for f in found)
+        # silently drop the chunked-carry invariant — for BOTH families'
+        # accounting in the IR.
+        text = (PKG / "ops" / "kernel_ir.py").read_text()
+        for fn in ("dense_chunk_carry_bytes", "sort_chunk_carry_bytes"):
+            mutated = text.replace(f"def {fn}", "def renamed_carry_bytes")
+            found = kc(mutated, path="ops/kernel_ir.py")
+            # The loud path must surface under kernel-unresolved (NOT
+            # kernel-vmem-budget): a baselined budget rule must never
+            # swallow a vanished accounting fn.
+            assert any(f.rule == "kernel-unresolved"
+                       and "not resolvable" in f.message
+                       for f in found), fn
 
     def test_well_formed_fixture_is_clean(self):
         assert kc(FIXTURE_KERNEL) == []
