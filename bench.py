@@ -119,7 +119,15 @@ def probe_with_retry(keep_env_pin: bool) -> tuple[str | None, int]:
         time.sleep(min(RETRY_SLEEP_S, max(0.0, deadline - time.monotonic())))
 
 
-from jepsen_jgroups_raft_tpu.platform import pin_cpu  # noqa: E402
+from jepsen_jgroups_raft_tpu.platform import env_int, pin_cpu  # noqa: E402
+
+
+def bench_pin_cpu() -> None:
+    """CPU pin honoring the distributed launcher's per-process virtual
+    device split (JGRAFT_BENCH_VDEVS, default 8 — the single-process
+    production mesh). Without this, `pin_cpu()`'s raise-to-8 would undo
+    the N-way device split `bench.py --distributed` hands each child."""
+    pin_cpu(env_int("JGRAFT_BENCH_VDEVS", 8, minimum=1))
 
 
 def allow_degraded() -> bool:
@@ -300,7 +308,7 @@ def beat() -> None:
 
 def _already_on_cpu() -> bool:
     """True when this process is already running the CPU fallback —
-    via the re-exec env pins OR the in-process pin_cpu() degrade paths
+    via the re-exec env pins OR the in-process bench_pin_cpu() degrade paths
     (probe-window failure / JAX_PLATFORMS=cpu), which set no env var."""
     if (os.environ.get("JGRAFT_BENCH_PLATFORM") == "cpu"
             or os.environ.get("JGRAFT_BENCH_DEGRADED")):
@@ -383,10 +391,23 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         encode_history, macro_events_on, pack_batch, pack_macro_batch)
     from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
     from jepsen_jgroups_raft_tpu.models.register import CasRegister
-    from jepsen_jgroups_raft_tpu.parallel.distributed import maybe_init_distributed
-    from jepsen_jgroups_raft_tpu.parallel.mesh import check_batch_sharded, make_mesh
+    from jepsen_jgroups_raft_tpu.parallel import distributed
+    from jepsen_jgroups_raft_tpu.parallel.distributed import (
+        maybe_init_distributed)
+    from jepsen_jgroups_raft_tpu.parallel.mesh import (check_batch_sharded,
+                                                       local_mesh, make_mesh)
 
     maybe_init_distributed()
+    # ISSUE 7: inside a cluster (bench.py --distributed N locally, or
+    # the standard env on a pod) every process runs this same body on
+    # its contiguous ROW SHARD: per-host encode+pack (the tensors are
+    # born on their shard and the host-side Python parallelizes across
+    # host CPUs), host-local chunked wavefront over the local mesh, and
+    # one counts-exchange per rep (the cross-host sync). Verdict
+    # soundness = batch-axis independence (doc/checker-design.md §10).
+    dist_on = distributed.wavefront_active()
+    nproc_cluster = distributed.process_count()
+    cluster_pid = distributed.process_index()
 
     n_procs = 5
     rng = random.Random(20260729)
@@ -402,9 +423,27 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
     from jepsen_jgroups_raft_tpu.ops.dense_scan import dense_plans_grouped
     from jepsen_jgroups_raft_tpu.ops.linear_scan import bucket_slots
 
-    encs = [encode_history(h, model) for h in histories]
-    n_slots = bucket_slots(max(e.n_slots for e in encs))
-    mesh = make_mesh()
+    if dist_on:
+        lo, hi = distributed.shard_bounds(
+            n_histories, granularity=distributed.placement_granularity())
+    else:
+        lo, hi = 0, n_histories
+    # Per-host encode: only this shard's rows ride the (host-dominant)
+    # encode pass; synthesis stays global so every process agrees on
+    # the batch without exchanging histories.
+    encs = [encode_history(h, model) for h in histories[lo:hi]]
+    n_slots = bucket_slots(max((e.n_slots for e in encs), default=1))
+    mesh = local_mesh() if dist_on else make_mesh()
+
+    def merge_counts(n_valid, n_unknown):
+        """Global verdict counts over the cluster — one coordination-
+        service exchange per timed rep (the rep's cross-host sync
+        point); identity single-process."""
+        if not dist_on:
+            return n_valid, n_unknown
+        totals = distributed.exchange_i64([int(n_valid), int(n_unknown)])
+        return (sum(int(t[0]) for t in totals),
+                sum(int(t[1]) for t in totals))
     # Dense-bitset kernels when a history's value domain allows it (the
     # north-star register shape does), grouped by concurrency window
     # (kernel cost is exponential in W; a batch's windows spread with how
@@ -483,6 +522,7 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
                 model, rest_events, mesh, n_slots=n_slots)
             n_valid += nv
             n_unknown += nu
+        n_valid, n_unknown = merge_counts(n_valid, n_unknown)
         t2 = time.perf_counter()
         return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
                 {"scan_steps": scan_steps})
@@ -525,6 +565,7 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
                 mesh, n_slots=n_slots)
             n_valid += nv
             n_unknown += nu
+        n_valid, n_unknown = merge_counts(n_valid, n_unknown)
         t2 = time.perf_counter()
         return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
                 dict(consume_stats(), scan_steps=scan_steps))
@@ -553,6 +594,7 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             _, _, nv, nu = fin()
             n_valid += nv
             n_unknown += nu
+        n_valid, n_unknown = merge_counts(n_valid, n_unknown)
         t2 = time.perf_counter()
         return (t2 - t0, t1 - t0, t2 - t1, n_valid, n_unknown,
                 {"scan_steps": scan_steps})
@@ -596,6 +638,15 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),
+        # Multi-host placement (ISSUE 7): n_processes = cluster size
+        # (1 single-process); per_host_pack_s = THIS host's shard pack
+        # wall (== pack_time_s; named so cross-process rows are
+        # comparable — each host packs only rows_local of the batch).
+        "n_processes": nproc_cluster,
+        "process_id": cluster_pid,
+        "rows_local": hi - lo,
+        "devices_local": len(jax.local_devices()),
+        "per_host_pack_s": round(dt_pack, 3),
         # Chunked-wavefront counters (checker/schedule.py; all zero when
         # JGRAFT_SCAN_CHUNK=0 pins the legacy monolithic scan):
         # evicted_rows = rows retired before their group's monolithic-
@@ -973,7 +1024,7 @@ def resolve_platform() -> str:
     if os.environ.get("JGRAFT_BENCH_PLATFORM"):  # explicit override
         platform = os.environ["JGRAFT_BENCH_PLATFORM"]
         if platform == "cpu":
-            pin_cpu()
+            bench_pin_cpu()
         else:
             # Actually pin the named platform — otherwise the default
             # backend would initialize instead (and can hang).
@@ -984,13 +1035,13 @@ def resolve_platform() -> str:
         return f"forced:{platform}"
     env_pin = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
     if env_pin == "cpu":
-        pin_cpu()
+        bench_pin_cpu()
         return "cpu (env-pinned)"
     platform, attempts = probe_with_retry(keep_env_pin=bool(env_pin))
     suffix = f" after {attempts} probes" if attempts > 1 else ""
     if platform is None or platform == "cpu":
         if platform is None:
-            pin_cpu()
+            bench_pin_cpu()
             note = (f"cpu (platform probe failed/timed out{suffix} over "
                     f"{RETRY_WINDOW_S:.0f} s window — TPU unreachable, "
                     "degraded to host CPU)")
@@ -1003,7 +1054,7 @@ def resolve_platform() -> str:
 
             note_degraded(note)
             return note
-        pin_cpu()
+        bench_pin_cpu()
         return f"cpu ({'env-pinned' if env_pin else 'default backend'})"
     kind = "env-pinned" if env_pin else "default backend"
     if env_pin and "cpu" not in os.environ["JAX_PLATFORMS"].split(","):
@@ -1015,11 +1066,29 @@ def resolve_platform() -> str:
 
 
 def main() -> None:
+    if "--distributed" in sys.argv:
+        # ISSUE 7: parent side of the N-process topology — spawn the
+        # localhost CPU-mesh cluster re-running this same bench (minus
+        # the flag) in every process and forward process 0's JSON. On
+        # a real pod, run bench.py once per host with the standard
+        # cluster env instead (doc/running.md "Multi-host checking").
+        from jepsen_jgroups_raft_tpu.parallel.launch import (
+            run_distributed_bench)
+
+        sys.exit(run_distributed_bench(sys.argv))
     # The intended platform is what the operator asked for BEFORE
     # resolution — resolve_platform's degrade path pins the env to cpu,
     # which must not launder the target the gate compares against.
     target = target_platform()
     note = resolve_platform()
+    # Cluster init must precede the FIRST backend touch (the platform
+    # gate's jax.devices() below): jax.distributed.initialize refuses
+    # once any computation ran. resolve_platform only pins config and
+    # probes in subprocesses, so this is the earliest safe point.
+    from jepsen_jgroups_raft_tpu.parallel.distributed import (
+        maybe_init_distributed)
+
+    maybe_init_distributed()
     beat()
     if degraded := os.environ.get("JGRAFT_BENCH_DEGRADED"):
         # Fold the re-exec'd run's original failure into the note

@@ -233,9 +233,10 @@ def consume_counters() -> dict:
 
 def applied_log() -> List[dict]:
     """Bounded log of {seq, signature, plan, source} entries, in
-    application order."""
+    application order (the recording thread id stays internal)."""
     with _LOCK:
-        return list(_APPLIED)
+        return [{k: v for k, v in e.items() if k != "thread"}
+                for e in _APPLIED]
 
 
 def applied_seq() -> int:
@@ -248,11 +249,17 @@ def applied_seq() -> int:
         return _APPLIED_SEQ
 
 
-def applied_since(seq: int) -> List[dict]:
+def applied_since(seq: int, thread_id: Optional[int] = None) -> List[dict]:
     """Entries applied after `seq` that are still inside the bounded
-    log (a span applying more than the bound keeps the newest)."""
+    log (a span applying more than the bound keeps the newest).
+    `thread_id` restricts to plans applied BY that thread — graftd's
+    concurrent shard executors (ISSUE 7) each stamp only the plans
+    their own batch's launch consulted, not a neighbor shard's."""
     with _LOCK:
-        return [dict(e) for e in _APPLIED if e["seq"] > seq]
+        return [{k: v for k, v in e.items() if k != "thread"}
+                for e in _APPLIED
+                if e["seq"] > seq
+                and (thread_id is None or e.get("thread") == thread_id)]
 
 
 def _record_applied(sig: tuple, plan: TunedPlan, source: str) -> None:
@@ -260,7 +267,8 @@ def _record_applied(sig: tuple, plan: TunedPlan, source: str) -> None:
     with _LOCK:
         _APPLIED_SEQ += 1
         _APPLIED.append({"seq": _APPLIED_SEQ, "signature": list(sig),
-                         "plan": asdict(plan), "source": source})
+                         "plan": asdict(plan), "source": source,
+                         "thread": threading.get_ident()})
         del _APPLIED[:-256]
 
 

@@ -110,7 +110,7 @@ def _route_group_to_host(n_rows: int, n_events: int) -> bool:
     if jax.default_backend() != "tpu":
         return False  # already on the host — nothing to route
     try:
-        jax.devices("cpu")
+        jax.local_devices(backend="cpu")
     except RuntimeError:
         return False  # cpu backend unavailable (JAX_PLATFORMS pinned)
     return n_rows * n_events < PLATFORM_ROUTE_MIN_CELLS
@@ -147,6 +147,7 @@ def check_encoded(
     n_slots: Optional[int] = None,
     witness: bool = False,
     max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
+    distribute: bool = True,
 ) -> list[dict]:
     """Pack-once/check-many entry: verify histories that are ALREADY
     encoded (`history.packing.encode_history`), one result dict each.
@@ -159,9 +160,29 @@ def check_encoded(
     below treat those foreign rows exactly like a single caller's batch
     (rows are independent along the batch axis; doc/checker-design.md
     §8). `check_histories` is the encode-then-delegate wrapper.
+
+    Multi-host (ISSUE 7): inside an initialized `jax.distributed`
+    cluster this entry runs the SHARDED wavefront — each process checks
+    only its contiguous row shard through the ordinary machinery below
+    and the per-row verdicts are exchanged so every process returns the
+    full batch (parallel/distributed.run_sharded; placement model in
+    doc/checker-design.md §10). The caller contract is SPMD: every
+    process calls with the same batch — true of the bench and the
+    `check` CLI run once per host. `distribute=False` (graftd's
+    per-host scheduler, whose admission queues are host-local) and
+    ``JGRAFT_DISTRIBUTED=0`` both pin the single-process path; outside
+    a cluster the seam is inert by construction.
     """
-    results = _check_encoded(encs, model, algorithm, n_configs,
-                             n_slots, witness, max_cpu_configs)
+    from ..parallel import distributed
+
+    if distribute and distributed.wavefront_active() and len(encs) > 1:
+        results = distributed.run_sharded(
+            encs,
+            lambda sub: _check_encoded(sub, model, algorithm, n_configs,
+                                       n_slots, witness, max_cpu_configs))
+    else:
+        results = _check_encoded(encs, model, algorithm, n_configs,
+                                 n_slots, witness, max_cpu_configs)
     note = degraded_note()
     if note:
         # The platform silently degraded (TPU probe failed / tunnel
@@ -464,7 +485,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                             # sharding, so both placements coexist.
                             import jax
 
-                            host = jax.devices("cpu")[0]
+                            host = jax.local_devices(backend="cpu")[0]
                             ev = jax.device_put(ev, host)
                             val_of = jax.device_put(val_of, host)
                             tag += "@host"

@@ -92,14 +92,26 @@ _STATS_LOCK = threading.Lock()
 _STATS_ZERO = {"chunks_run": 0, "evicted_rows": 0, "groups_run": 0,
                "groups_early_exited": 0, "pipeline_overlap_s": 0.0}
 _STATS = dict(_STATS_ZERO)
-_SCOPES: List[dict] = []  # guarded by _STATS_LOCK; innermost last
+#: (scope dict, owner thread id) pairs; guarded by _STATS_LOCK,
+#: innermost last. The owner id makes attribution THREAD-AFFINE under
+#: concurrent scopes (graftd's multi-worker shards, ISSUE 7): counters
+#: recorded by a thread that owns scopes land ONLY in that thread's
+#: scopes — two shard executors checking concurrently no longer sum
+#: each other's counters into both batches' stats. Counters from a
+#: thread owning NO scope (race mode's engine threads) keep the old
+#: every-active-scope behavior, so single-worker attribution is
+#: unchanged bit for bit.
+_SCOPES: List[tuple] = []
 
 
 def _add_stats(**kw) -> None:
+    tid = threading.get_ident()
     with _STATS_LOCK:
+        owned = [s for s, o in _SCOPES if o == tid]
+        targets = owned if owned else [s for s, _ in _SCOPES]
         for k, v in kw.items():
             _STATS[k] += v
-            for scope in _SCOPES:
+            for scope in targets:
                 scope[k] += v
 
 
@@ -125,7 +137,7 @@ def stats_scope(label: Optional[str] = None):
     if label is not None:
         scope["label"] = label
     with _STATS_LOCK:
-        _SCOPES.append(scope)
+        _SCOPES.append((scope, threading.get_ident()))
     try:
         yield scope
     finally:
@@ -134,7 +146,7 @@ def stats_scope(label: Optional[str] = None):
             # two scopes with identical counters (e.g. nested, both
             # still zero) are equal dicts — remove() would pop the
             # outer one and crash the outer exit.
-            for i, s in enumerate(_SCOPES):
+            for i, (s, _) in enumerate(_SCOPES):
                 if s is scope:
                     del _SCOPES[i]
                     break
@@ -143,12 +155,17 @@ def stats_scope(label: Optional[str] = None):
 def snapshot_stats(scoped: bool = False) -> dict:
     """Copy of the accumulated chunked-scan counters (non-destructive).
     `scoped=True` returns the innermost active `stats_scope`'s counters
-    — this run's work only — falling back to the process totals when no
-    scope is active (direct `check_histories` callers outside a test
-    run)."""
+    — this run's work only; with concurrent scopes (multi-worker
+    graftd) the innermost scope OWNED BY THIS THREAD wins — falling
+    back to the process totals when no scope is active (direct
+    `check_histories` callers outside a test run)."""
     with _STATS_LOCK:
         if scoped and _SCOPES:
-            return dict(_SCOPES[-1])
+            tid = threading.get_ident()
+            for s, o in reversed(_SCOPES):
+                if o == tid:
+                    return dict(s)
+            return dict(_SCOPES[-1][0])
         return dict(_STATS)
 
 
@@ -320,7 +337,10 @@ def build_dense_launches(model, groups, host_route=None):
             import jax
 
             tag += "@host"
-            placement = jax.devices("cpu")[0]
+            # Local cpu device: in a multi-process runtime
+            # jax.devices("cpu") lists every host's cpu devices and
+            # [0] may be a non-addressable remote one.
+            placement = jax.local_devices(backend="cpu")[0]
         elif exact:
             placement = None
         elif tuned is not None and tuned.mesh_fanout > 0:

@@ -217,7 +217,8 @@ def cmd_serve_checker(args) -> int:
                          port=args.port, queue_capacity=args.queue,
                          batch_wait=(args.batch_wait_ms / 1000.0
                                      if args.batch_wait_ms is not None
-                                     else None))
+                                     else None),
+                         n_workers=args.workers)
 
 
 def cmd_check(args) -> int:
@@ -272,6 +273,10 @@ def main(argv=None) -> int:
     sc.add_argument("--batch-wait-ms", type=int, default=None,
                     help="batch-formation linger "
                          "(default: JGRAFT_SERVICE_BATCH_WAIT_MS or 50)")
+    sc.add_argument("--workers", type=int, default=None,
+                    help="worker shards — one per host/device group; "
+                         "batches route to the least-loaded shard "
+                         "(default: JGRAFT_SERVICE_WORKERS or 1)")
     sc.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="pin the JAX backend for checking")
     sc.set_defaults(fn=cmd_serve_checker)

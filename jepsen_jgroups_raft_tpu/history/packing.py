@@ -447,16 +447,49 @@ def bucket_opens(n: int, cap: int = MACRO_MAX_OPENS) -> int:
     return min(_bucket_pow2(max(int(n), 1), 1), cap)
 
 
+def _macro_group_counts(events: np.ndarray):
+    """Shared metadata pass behind the macro row math: (counts, nF,
+    open_idx, force_idx, grp) where counts[i] = opens in macro group i
+    (group i's opens precede force i; group nF is the trailing
+    never-forced run). `max_open_run`, `macro_row_count`, and
+    `macro_compact` all derive from these — one definition so the
+    shard packers' cheap counting pass can never drift from the
+    compaction itself."""
+    events = np.asarray(events, dtype=np.int32)
+    et = events[:, 0] if events.size else np.empty((0,), np.int32)
+    open_idx = np.flatnonzero(et == EV_OPEN)
+    force_idx = np.flatnonzero(et == EV_FORCE)
+    grp = np.searchsorted(force_idx, open_idx, side="left")
+    counts = np.bincount(grp, minlength=len(force_idx) + 1)
+    return counts, len(force_idx), open_idx, force_idx, grp
+
+
+def _macro_rows_from_counts(counts: np.ndarray, nF: int, macro_p: int) -> int:
+    """Row-count half of the macro math given a history's (counts, nF)
+    metadata: ⌈opens/P⌉ latch rows per group, minimum one row per
+    FORCE."""
+    n_rows = -(-counts // int(macro_p))
+    n_rows[:nF] = np.maximum(n_rows[:nF], 1)
+    return int(n_rows.sum())
+
+
+def macro_row_count(events: np.ndarray, macro_p: int) -> int:
+    """Macro rows `macro_compact(events, macro_p)` would produce,
+    WITHOUT building them. The per-host packers size the batch-global
+    macro row count E from this counting pass and then compact only
+    their own shard."""
+    counts, nF, _, _, _ = _macro_group_counts(events)
+    return _macro_rows_from_counts(counts, nF, macro_p)
+
+
 def max_open_run(events: np.ndarray) -> int:
     """Longest run of consecutive OPEN events (the quantity P buckets):
     opens are grouped by the number of FORCEs preceding them — the
     trailing group (crashed never-forced opens) counts too."""
-    et = np.asarray(events)[:, 0]
-    is_open = et == EV_OPEN
-    if not is_open.any():
+    counts, _, open_idx, _, _ = _macro_group_counts(events)
+    if not len(open_idx):
         return 0
-    grp = np.cumsum(et == EV_FORCE)[is_open]
-    return int(np.bincount(grp).max())
+    return int(counts.max())
 
 
 def macro_compact(events: np.ndarray, macro_p: int) -> np.ndarray:
@@ -478,14 +511,9 @@ def macro_compact(events: np.ndarray, macro_p: int) -> np.ndarray:
     tests/test_macro_events.py)."""
     P = int(macro_p)
     events = np.asarray(events, dtype=np.int32)
-    et = events[:, 0]
-    open_idx = np.flatnonzero(et == EV_OPEN)
-    force_idx = np.flatnonzero(et == EV_FORCE)
-    nF = len(force_idx)
     # Open group = number of FORCEs strictly before the open (group i's
     # opens precede force i; group nF is the trailing never-forced run).
-    grp = np.searchsorted(force_idx, open_idx, side="left")
-    counts = np.bincount(grp, minlength=nF + 1)
+    counts, nF, open_idx, force_idx, grp = _macro_group_counts(events)
     # Rows per group: ⌈opens/P⌉ latch rows, the last one carrying the
     # group's FORCE; a force with no fresh opens still needs its row.
     n_rows = -(-counts // P)
@@ -549,4 +577,122 @@ def pack_macro_batch(
         "n_slots": ns,
         "macro_p": P,
         "legacy_events": max(e.n_events for e in encs),
+    }
+
+
+def _shard_slice(n_encs: int, process_index: int, process_count: int,
+                 n_rows: Optional[int]) -> tuple:
+    """(lo, hi, n_rows) for a per-host pack: the shard's row range over
+    the GLOBAL row count (≥ the batch size when the caller pre-pads for
+    a global mesh; the extra rows are EV_PAD no-op histories assigned
+    to the trailing shards)."""
+    from ..parallel.distributed import shard_bounds
+
+    n_rows = n_encs if n_rows is None else int(n_rows)
+    if n_rows < n_encs:
+        raise ValueError(f"n_rows {n_rows} smaller than batch {n_encs}")
+    lo, hi = shard_bounds(n_rows, process_count, process_index)
+    return lo, hi, n_rows
+
+
+def pack_batch_shard(
+    encoded: Sequence[EncodedHistory],
+    process_index: int,
+    process_count: int,
+    n_rows: Optional[int] = None,
+    n_events: Optional[int] = None,
+) -> dict:
+    """Per-host twin of `pack_batch` (ISSUE 7): pad/fill ONLY the row
+    shard process `process_index` of `process_count` owns, at the
+    batch-GLOBAL event length — so the shard tensors of all processes,
+    concatenated in process order, equal `pack_batch` of the whole
+    batch row for row (shard-local pack ≡ global pack then shard;
+    doc/checker-design.md §10, pinned by tests/test_distributed.py).
+    Each host therefore pays only its shard's share of the fill work,
+    and the tensor is born on its shard. `n_rows` (≥ batch size) adds
+    global EV_PAD padding rows for mesh-divisible launches. Extra keys:
+    ``shard`` = (lo, hi) and ``n_rows_global``."""
+    encs = list(encoded)
+    if not encs:
+        raise ValueError("empty batch")
+    E = n_events or max(e.n_events for e in encs)
+    if any(e.n_events > E for e in encs):
+        raise ValueError("n_events smaller than longest history")
+    lo, hi, n_rows = _shard_slice(len(encs), process_index, process_count,
+                                  n_rows)
+    B_local = hi - lo
+    events = np.zeros((B_local, E, 5), dtype=np.int32)
+    op_index = np.full((B_local, E), -1, dtype=np.int32)
+    ne = np.zeros((B_local,), dtype=np.int32)
+    ns = np.zeros((B_local,), dtype=np.int32)
+    for j, e in enumerate(encs[lo:min(hi, len(encs))]):
+        events[j, : e.n_events] = e.events
+        op_index[j, : e.n_events] = e.op_index
+        ne[j] = e.n_events
+        ns[j] = e.n_slots
+    return {
+        "events": events,
+        "op_index": op_index,
+        "n_events": ne,
+        "n_slots": ns,
+        "shard": (lo, hi),
+        "n_rows_global": n_rows,
+    }
+
+
+def pack_macro_batch_shard(
+    encoded: Sequence[EncodedHistory],
+    process_index: int,
+    process_count: int,
+    n_rows: Optional[int] = None,
+    n_events: Optional[int] = None,
+    cap: int = MACRO_MAX_OPENS,
+) -> dict:
+    """Per-host twin of `pack_macro_batch` (ISSUE 7 tentpole (b)). The
+    batch-GLOBAL shapes — payload width P (longest open run anywhere in
+    the batch) and macro row count E — are computed from every
+    history's metadata via the cheap counting pass
+    (`_macro_group_counts` / `macro_row_count`, no row assembly), then
+    ONLY this process's row shard is actually compacted and filled. The
+    concatenation of every process's output equals `pack_macro_batch`
+    of the whole batch, row for row, so the per-host tensors feed the
+    same compiled kernels at the same shapes (soundness:
+    doc/checker-design.md §10; identity pinned by
+    tests/test_distributed.py). This parallelizes the dominant
+    host-side pack cost — `macro_compact` + array fill — across host
+    CPUs (`scripts/ab_distributed.py` measures the win)."""
+    encs = list(encoded)
+    if not encs:
+        raise ValueError("empty batch")
+    # ONE metadata pass per history: (counts, nF) feeds both the
+    # batch-global payload width P (longest run = counts.max()) and,
+    # at that P, every history's macro row count — the batch-global
+    # half of the pack cost every host pays, so it must not scan the
+    # event arrays twice.
+    metas = [_macro_group_counts(e.events)[:2] for e in encs]
+    P = bucket_opens(max(int(c.max()) if c.size else 0 for c, _ in metas),
+                     cap)
+    row_counts = [_macro_rows_from_counts(c, nF, P) for c, nF in metas]
+    E = n_events or max(max(row_counts), 1)
+    if any(c > E for c in row_counts):
+        raise ValueError("n_events smaller than longest macro stream")
+    lo, hi, n_rows = _shard_slice(len(encs), process_index, process_count,
+                                  n_rows)
+    B_local = hi - lo
+    events = np.zeros((B_local, E, 3 + 4 * P), dtype=np.int32)
+    ne = np.zeros((B_local,), dtype=np.int32)
+    ns = np.zeros((B_local,), dtype=np.int32)
+    for j, e in enumerate(encs[lo:min(hi, len(encs))]):
+        c = macro_compact(e.events, P)
+        events[j, : c.shape[0]] = c
+        ne[j] = c.shape[0]
+        ns[j] = e.n_slots
+    return {
+        "events": events,
+        "n_events": ne,
+        "n_slots": ns,
+        "macro_p": P,
+        "legacy_events": max(e.n_events for e in encs),
+        "shard": (lo, hi),
+        "n_rows_global": n_rows,
     }

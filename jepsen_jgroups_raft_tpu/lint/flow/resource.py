@@ -59,7 +59,13 @@ _ADOPT = {"append", "add", "insert", "put", "register", "setdefault",
           "store"}
 
 SCAN_FILES = ("deploy/ssh.py", "deploy/local.py", "core/runner.py",
-              "core/db.py")
+              "core/db.py",
+              # ISSUE-7 distributed tier: the multi-process launcher
+              # holds subprocess handles + the coordinator port socket
+              # across exception paths (a leaked child is a whole
+              # wedged interpreter, not just an fd), and distributed.py
+              # owns the cluster runtime handles.
+              "parallel/distributed.py", "parallel/launch.py")
 
 #: The service tier (ISSUE-5) is scanned wholesale: graftd holds queue
 #: entries, per-call client sockets, trace file handles, and worker

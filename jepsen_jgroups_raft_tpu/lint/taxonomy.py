@@ -60,9 +60,14 @@ LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
 #: Default CLI scan set, relative to the package root. The service
 #: tier (graftd, ISSUE-5) and both stdlib HTTP servers are covered: a
 #: long-lived daemon is where a silently-swallowed broad except turns
-#: into an unexplained wedge instead of a crashed run.
+#: into an unexplained wedge instead of a crashed run. The distributed
+#: tier (ISSUE-7) rides along: its degrade paths (malformed cluster
+#: env, failed init, unsupported collectives) are broad-except-shaped
+#: by design and must stay VISIBLE — a silent swallow there is exactly
+#: the r01–r05 silent-CPU pattern at cluster scale.
 SCAN_PREFIXES = ("client/", "workload/", "deploy/", "service/")
-SCAN_FILES = ("core/runner.py", "native/client.py", "core/serve.py")
+SCAN_FILES = ("core/runner.py", "native/client.py", "core/serve.py",
+              "parallel/distributed.py", "parallel/launch.py")
 
 
 def applies_to(relpath: str) -> bool:

@@ -44,13 +44,27 @@ BATCH_AXIS = "data"
 
 
 def make_mesh(n_devices: Optional[int] = None,
-              axis_name: str = BATCH_AXIS) -> Mesh:
-    """1-D mesh over the first `n_devices` devices (default: all)."""
-    devs = jax.devices()
+              axis_name: str = BATCH_AXIS,
+              devices: Optional[list] = None) -> Mesh:
+    """1-D mesh over the first `n_devices` of `devices` (default: ALL
+    devices — in a multi-process runtime that is every process's
+    devices, the global mesh of parallel/distributed.py; pass
+    `jax.local_devices()` or use `local_mesh` for a host-local one)."""
+    devs = jax.devices() if devices is None else list(devices)
     n = len(devs) if n_devices is None else n_devices
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")
     return Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+def local_mesh(n_devices: Optional[int] = None,
+               axis_name: str = BATCH_AXIS) -> Mesh:
+    """1-D mesh over THIS process's devices only. Identical to
+    `make_mesh` single-process; in a cluster it is the host-local ICI
+    mesh the sharded wavefront fans out over (host numpy arrays can
+    only be `device_put` onto addressable devices — a global-mesh
+    sharding would reject them)."""
+    return make_mesh(n_devices, axis_name, devices=jax.local_devices())
 
 
 def launch_fan_out() -> bool:
@@ -103,7 +117,12 @@ def chunk_sharding(n_devices: Optional[int] = None):
 
     if not launch_fan_out():
         return None
-    devs = jax.devices()
+    # LOCAL devices only: the wavefront scheduler device_puts host
+    # numpy slices under this sharding, which requires every shard to
+    # be addressable — in a multi-process runtime each host fans its
+    # row shard over its own ICI mesh (parallel/distributed.py owns
+    # the cross-host split). Identical to jax.devices() single-process.
+    devs = jax.local_devices()
     cap = env_int("JGRAFT_GROUP_DEVICES", len(devs), minimum=0)
     if n_devices is not None:
         cap = min(cap, max(int(n_devices), 0))

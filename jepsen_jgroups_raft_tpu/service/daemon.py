@@ -39,9 +39,66 @@ from .admission import (AdmissionQueue, QueueFull, ResultCache,
                         ServiceStopped)
 from .request import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, CheckRequest,
                       admit, admit_run_dir)
-from .scheduler import BatchScheduler
+from .scheduler import BatchScheduler, ShardLoads
 
 LOG = logging.getLogger("jgraft.service")
+
+
+class _ShardQueue:
+    """Closeable per-shard work queue. The close/put race matters: a
+    dispatcher routing a batch while shutdown drains the queues would
+    otherwise strand the batch forever — its requests were already
+    popped from the admission queue (so its drain misses them) and the
+    executors have exited (the same shutdown/submit race PR 5 closed at
+    the AdmissionQueue with `close()`; this is the routed-batch twin).
+    `put` refuses under the same lock as the insert, so a routed batch
+    either lands before `close_and_drain` (and is failed by it) or is
+    refused (and the dispatcher fails it) — never silently stranded."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items: deque = deque()
+        self._closed = False
+
+    def put(self, item) -> bool:
+        with self._cond:
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._cond.notify()
+            return True
+
+    def get(self, timeout: float):
+        """Next item, or None on timeout / closed-and-empty."""
+        with self._cond:
+            if not self._items and not self._closed:
+                self._cond.wait(timeout)
+            if self._items:
+                return self._items.popleft()
+            return None
+
+    def close_and_drain(self) -> list:
+        with self._cond:
+            self._closed = True
+            items = list(self._items)
+            self._items.clear()
+            self._cond.notify_all()
+            return items
+
+    def reopen(self) -> None:
+        with self._cond:
+            self._closed = False
+
+
+def default_workers() -> int:
+    """Worker shards (JGRAFT_SERVICE_WORKERS, default 1 — today's
+    single-worker daemon, bit for bit). On a multi-device or multi-host
+    deployment set one worker per host/device group so independent
+    shape-bucket batches check concurrently instead of serializing
+    through one thread (ISSUE 7 tentpole (c)); defensively parsed."""
+    from ..platform import env_int
+
+    return env_int("JGRAFT_SERVICE_WORKERS", 1, minimum=1)
 
 #: Poll granularity of the worker loop (also the shutdown latency
 #: bound). The queue condition wakes the worker instantly on arrival;
@@ -75,6 +132,7 @@ class CheckingService:
                  max_batch_rows: Optional[int] = None,
                  cache_capacity: Optional[int] = None,
                  check_fn=None, host_fallback=None,
+                 n_workers: Optional[int] = None,
                  autostart: bool = True):
         self.name = name
         self.store_root = Path(store_root) if store_root else None
@@ -84,6 +142,18 @@ class CheckingService:
         self.scheduler = BatchScheduler(
             self.queue, check_fn=check_fn, host_fallback=host_fallback,
             max_batch_rows=max_batch_rows, batch_wait=batch_wait)
+        # Worker shards (ISSUE 7): 1 = today's single supervised worker
+        # executing inline; N > 1 = the same loop becomes a DISPATCHER
+        # that routes each formed batch to the least-loaded shard's
+        # executor thread, so independent shape buckets check
+        # concurrently. Placement is stamped into per-request stats.
+        self.n_workers = max(1, n_workers if n_workers is not None
+                             else default_workers())
+        self.shards = ShardLoads(self.n_workers)
+        self._shard_queues: list = [_ShardQueue()
+                                    for _ in range(self.n_workers)]
+        self._executors: list = [None] * self.n_workers
+        self._inflight_by_shard: dict = {}
         self._requests: dict = {}
         self._terminal: deque = deque()  # finished ids, oldest first
         self._retain = retain_capacity()
@@ -108,36 +178,59 @@ class CheckingService:
     def start(self) -> None:
         self._stop.clear()
         self.queue.reopen()
+        for q in self._shard_queues:
+            q.reopen()
         self._started = True
         self._ensure_worker()
 
     def _ensure_worker(self) -> None:
-        """Spawn (or respawn after death) the supervised worker. Called
-        under submit too, so a STARTED daemon whose worker died serves
-        the next tenant instead of silently queueing forever (a daemon
-        built with autostart=False stays parked until `start()` — the
+        """Spawn (or respawn after death) the supervised dispatcher and,
+        for n_workers > 1, the per-shard executors. Called under submit
+        too, so a STARTED daemon whose worker died serves the next
+        tenant instead of silently queueing forever (a daemon built
+        with autostart=False stays parked until `start()` — the
         deterministic-coalescing mode tests and the CI smoke use)."""
         with self._lock:
             if self._stop.is_set() or not self._started:
                 return
-            if self._worker is not None and self._worker.is_alive():
-                return
-            self._worker = threading.Thread(
-                target=self._supervised_loop, daemon=True,
-                name=f"{self.name}-worker")
-            self._worker.start()
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._supervised_loop, daemon=True,
+                    name=f"{self.name}-worker")
+                self._worker.start()
+            if self.n_workers > 1:
+                for k in range(self.n_workers):
+                    t = self._executors[k]
+                    if t is None or not t.is_alive():
+                        t = threading.Thread(
+                            target=self._supervised_executor, args=(k,),
+                            daemon=True, name=f"{self.name}-shard{k}")
+                        self._executors[k] = t
+                        t.start()
 
     def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
-        """Stop the worker; queued requests are failed loudly (a
+        """Stop the workers; queued requests are failed loudly (a
         shutdown is not a verdict). Idempotent. The queue is CLOSED
         before the drain, so a submission racing this call either
         lands before the drain (and is failed by it) or gets
         ServiceStopped from `put` — never a silently-stranded entry."""
         self._stop.set()
         self.queue.close()
+        # Close the shard queues BEFORE joining: a dispatcher mid-route
+        # either landed its batch (drained here) or gets a refused put
+        # and fails the batch itself — no window strands a routed batch
+        # (see _ShardQueue). Executors wake on the close and exit.
+        stranded = [item for q in self._shard_queues
+                    for item in q.close_and_drain()]
+        for batch, _rows, _placement in stranded:
+            self._fail_unexecuted(batch)
         worker = self._worker
         if wait and worker is not None and worker.is_alive():
             worker.join(timeout)
+        if wait:
+            for t in self._executors:
+                if t is not None and t.is_alive():
+                    t.join(timeout)
         drained = self.queue.take(lambda pending: list(pending), timeout=0.0)
         for r in drained:
             r.finish(FAILED, error="service shut down before execution")
@@ -168,29 +261,104 @@ class CheckingService:
                 self._ensure_worker()
 
     def _worker_loop(self) -> None:
+        """Single-worker mode: form and execute inline (today's loop).
+        Multi-worker mode (ISSUE 7): this loop is the DISPATCHER — it
+        forms batches and routes each to the least-loaded shard's
+        executor, so independent shape buckets run concurrently."""
         while not self._stop.is_set():
             batch = self.scheduler.next_batch(timeout=IDLE_POLL_S)
             if not batch:
                 continue
-            with self._lock:
-                self._inflight = list(batch)
-            try:
-                info = self.scheduler.execute(batch)
-                self._account_batch(batch, info)
-            except Exception:
-                # Even the host fallback failed (or a scheduler bug):
-                # fail THIS batch's requests, keep serving the queue.
-                LOG.exception("%s batch execution failed", self.name)
-                for r in batch:
-                    if r.status not in (DONE, CANCELLED, FAILED):
-                        r.finish(FAILED, error="batch execution raised; "
-                                 "see service log")
-                self._account_requests(batch)
-            finally:
+            rows = sum(r.n_rows for r in batch)
+            if self.n_workers == 1:
+                placement = {"shard": 0, "n_shards": 1,
+                             "loads_at_dispatch": self.shards.snapshot()}
+                self.shards.add(0, rows)
                 with self._lock:
-                    self._inflight = []
+                    self._inflight = list(batch)
+                try:
+                    self._run_batch(batch, placement)
+                finally:
+                    with self._lock:
+                        self._inflight = []
+                    self.shards.done(0, rows)
+                continue
+            k = self.shards.least_loaded()
+            placement = {"shard": k, "n_shards": self.n_workers,
+                         "loads_at_dispatch": self.shards.snapshot()}
+            self.shards.add(k, rows)
+            if not self._shard_queues[k].put((batch, rows, placement)):
+                # Shutdown closed the shard queues between formation
+                # and routing: fail the batch loudly, like the drains.
+                self.shards.done(k, rows)
+                self._fail_unexecuted(batch)
+
+    def _fail_unexecuted(self, batch) -> None:
+        """A shutdown is not a verdict: requests popped from admission
+        but never executed fail with the same error the queue drains
+        use."""
+        for r in batch:
+            if r.status in (QUEUED, RUNNING):
+                r.finish(FAILED,
+                         error="service shut down before execution")
+                self._count("failed")
+                self._retire(r)
+
+    def _run_batch(self, batch, placement: dict) -> None:
+        """Execute one formed batch (dispatcher inline or a shard
+        executor): batch-level failures fail only this batch's
+        requests; traces are written either way."""
+        try:
+            info = self.scheduler.execute(batch, placement=placement)
+            self._account_batch(batch, info)
+        except Exception:
+            # Even the host fallback failed (or a scheduler bug):
+            # fail THIS batch's requests, keep serving the queue.
+            LOG.exception("%s batch execution failed", self.name)
             for r in batch:
-                self._write_trace(r)
+                if r.status not in (DONE, CANCELLED, FAILED):
+                    r.finish(FAILED, error="batch execution raised; "
+                             "see service log")
+            self._account_requests(batch)
+        for r in batch:
+            self._write_trace(r)
+
+    def _supervised_executor(self, k: int) -> None:
+        """Shard executor k: drain this shard's routed batches. The
+        same survival contract as the dispatcher's supervisor: a dying
+        executor requeues its popped-but-unfinished batch into the
+        admission queue, bumps ``worker_restarts``, and is respawned —
+        queued tenants must survive an executor bug."""
+        try:
+            q = self._shard_queues[k]
+            while not self._stop.is_set():
+                item = q.get(timeout=IDLE_POLL_S)
+                if item is None:
+                    continue
+                batch, rows, placement = item
+                with self._lock:
+                    self._inflight_by_shard[k] = list(batch)
+                try:
+                    self._run_batch(batch, placement)
+                finally:
+                    with self._lock:
+                        self._inflight_by_shard[k] = []
+                    self.shards.done(k, rows)
+        except BaseException:
+            LOG.exception("%s shard %d executor died; restarting",
+                          self.name, k)
+            with self._lock:
+                inflight = self._inflight_by_shard.pop(k, [])
+            unfinished = [r for r in inflight
+                          if r.status in (QUEUED, RUNNING)]
+            for r in unfinished:
+                r.status = QUEUED
+            self.queue.requeue(unfinished)
+            self._count("worker_restarts")
+            if not self._stop.is_set():
+                with self._lock:
+                    self._executors[k] = None
+                self._ensure_worker()
 
     # ------------------------------------------------------ admission
 
@@ -294,6 +462,8 @@ class CheckingService:
                 lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4)
         worker = self._worker
         out["worker_alive"] = bool(worker is not None and worker.is_alive())
+        out["workers"] = self.n_workers
+        out["shard_loads"] = self.shards.snapshot()
         return out
 
     # ----------------------------------------------------- accounting

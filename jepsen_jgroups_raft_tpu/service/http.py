@@ -174,15 +174,18 @@ def make_server(service: CheckingService, host: str = "127.0.0.1",
 def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
                   port: int = 8091,
                   queue_capacity: Optional[int] = None,
-                  batch_wait: Optional[float] = None) -> int:
+                  batch_wait: Optional[float] = None,
+                  n_workers: Optional[int] = None) -> int:
     """CLI entry (`python -m jepsen_jgroups_raft_tpu serve-checker`):
     run graftd in the foreground until interrupted."""
     service = CheckingService(store_root=store_root,
                               queue_capacity=queue_capacity,
-                              batch_wait=batch_wait)
+                              batch_wait=batch_wait,
+                              n_workers=n_workers)
     httpd, bound = make_server(service, host, port)
     print(f"graftd: checking service on http://{host}:{bound}/ "
-          f"(queue={service.queue.capacity}, store={store_root})")
+          f"(queue={service.queue.capacity}, "
+          f"workers={service.n_workers}, store={store_root})")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

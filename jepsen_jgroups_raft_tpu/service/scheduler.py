@@ -71,6 +71,39 @@ PRIORITY_CREDIT_S = 1.0
 DEFAULT_MAX_BATCH_ROWS = 256
 
 
+class ShardLoads:
+    """Load accounting for graftd's worker shards (ISSUE 7 tentpole
+    (c)). A shard is one execution lane — one worker thread per
+    host/device group — and its load is the rows dispatched to it and
+    not yet finished. `least_loaded` is the routing rule the daemon's
+    dispatcher applies to every formed batch: independent shape-bucket
+    batches land on different shards and check CONCURRENTLY instead of
+    serializing through one worker. Deterministic (ties break to the
+    lowest shard id) so placement is testable; thread-safe (the
+    executors release from their own threads)."""
+
+    def __init__(self, n_shards: int):
+        self.n_shards = max(1, int(n_shards))
+        self._loads = [0] * self.n_shards
+        self._lock = threading.Lock()
+
+    def least_loaded(self) -> int:
+        with self._lock:
+            return min(range(self.n_shards), key=lambda k: self._loads[k])
+
+    def add(self, shard: int, rows: int) -> None:
+        with self._lock:
+            self._loads[shard] += rows
+
+    def done(self, shard: int, rows: int) -> None:
+        with self._lock:
+            self._loads[shard] = max(0, self._loads[shard] - rows)
+
+    def snapshot(self) -> List[int]:
+        with self._lock:
+            return list(self._loads)
+
+
 def batch_wait_s() -> float:
     """Resolved linger window (JGRAFT_SERVICE_BATCH_WAIT_MS; defensive
     parse — garbage warns and keeps the default)."""
@@ -111,8 +144,19 @@ class BatchScheduler:
                  aging_cap_s: float = AGING_CAP_S):
         from ..checker.linearizable import check_encoded, check_encoded_host
 
+        def _check_local(encs, model, algorithm="auto"):
+            # distribute=False: graftd's admission queue is HOST-local
+            # — different daemon processes hold different batches, so
+            # the cross-host SPMD seam (which barriers on every process
+            # checking the SAME batch) would deadlock a clustered
+            # daemon. Multi-host graftd is shard-routed per host
+            # instead: one daemon per host, each with its own workers
+            # (doc/checker-design.md §10).
+            return check_encoded(encs, model, algorithm=algorithm,
+                                 distribute=False)
+
         #: device-path seam (tests inject failures / gates here).
-        self.check_fn = check_fn or check_encoded
+        self.check_fn = check_fn or _check_local
         self.host_fallback = host_fallback or check_encoded_host
         self.max_batch_rows = (max_batch_rows if max_batch_rows is not None
                                else env_int("JGRAFT_SERVICE_MAX_BATCH_ROWS",
@@ -182,12 +226,15 @@ class BatchScheduler:
 
     # ------------------------------------------------------ execution
 
-    def execute(self, batch: List[CheckRequest]) -> dict:
+    def execute(self, batch: List[CheckRequest],
+                placement: Optional[dict] = None) -> dict:
         """Run one coalesced batch and demux; returns batch-level stats
         for the daemon's counters. Cancelled requests are finalized
         without results (a cancel landing mid-chunk is honored at
         demux: the row work is already spent, the verdict is simply
-        not delivered)."""
+        not delivered). `placement` (the daemon's shard-routing record:
+        shard id, shard count, loads at dispatch) is stamped into every
+        request's stats so a tenant's trace shows WHERE its launch ran."""
         live = []
         for r in batch:
             if r.cancelled.is_set():
@@ -209,9 +256,11 @@ class BatchScheduler:
         # Autotune consult marker (PR 6): the checker applies per-bucket
         # plans inside check_encoded; snapshot the applied-plan SEQUENCE
         # (not the bounded log's length — that pins at the bound once
-        # trimming starts) so this batch's requests stamp exactly the
-        # plans their launch used (the worker is single-threaded, so
-        # everything after the mark is this batch's).
+        # trimming starts). Entries are additionally filtered to THIS
+        # thread (ISSUE 7): with multiple shard executors running
+        # concurrently, "everything after the mark" would include
+        # neighbor shards' plans — the thread filter keeps each batch's
+        # stamp to exactly the plans its own launch consulted.
         autotune_mark = autotune.applied_seq()
         t0 = time.monotonic()
         with stats_scope(label=label) as scan:
@@ -243,7 +292,8 @@ class BatchScheduler:
                     res["platform-degraded"] = degraded_note_local
         wall = time.monotonic() - t0
         scan_counters = {k: v for k, v in scan.items() if k != "label"}
-        autotune_plans = autotune.applied_since(autotune_mark)
+        autotune_plans = autotune.applied_since(
+            autotune_mark, thread_id=threading.get_ident())
         cursor = 0
         for r in live:
             mine = results[cursor:cursor + r.n_rows]
@@ -255,6 +305,8 @@ class BatchScheduler:
                 "batch_wall_s": round(wall, 4),
                 "scan": dict(scan_counters, label=label),
                 "autotune_plans": autotune_plans,
+                "placement": dict(placement) if placement else
+                {"shard": 0, "n_shards": 1},
                 "degraded": degraded_note_local is not None,
             }
             if r.cancelled.is_set():

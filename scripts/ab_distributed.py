@@ -1,0 +1,155 @@
+"""Distributed-tier A/B (ISSUE 7 acceptance measurements).
+
+Methodology per the repo's perf rules (cross-process comparisons
+measure the host's mood — BENCH_r05→PR3 drift notes): everything that
+CAN be same-process is same-process and interleaved with per-rep order
+rotation; the one genuinely multi-process comparison (part 3) runs
+fresh interpreters for BOTH variants, alternating order per rep, so
+neither side systematically owns the warm cache.
+
+1. **Per-host packing** — global `pack_macro_batch` of the full batch
+   vs per-shard `pack_macro_batch_shard`; the per-host wall is the MAX
+   shard time (shards run concurrently on different hosts' CPUs in the
+   real topology). Acceptance: ≥ 1.3× at the north-star 1000×1k shape.
+2. **Row-shard verdict identity** — `check_encoded` of the full batch
+   vs the concatenation of per-shard `check_encoded` runs, asserted
+   bitwise-identical BEFORE anything is timed (the same-process half
+   of the acceptance pin; the real 2-process transport differential
+   lives in tests/test_distributed.py).
+3. **End-to-end** — `bench.py H W` (1 process, 8 vdevs) vs
+   `bench.py --distributed 2 H W` (2 processes, 4 vdevs each),
+   interleaved. Acceptance: 2-process ≥ 0.9× single-process hist/s on
+   this TPU-less host (overhead bound — the fan-out win is claimed on
+   real pods, per ROADMAP's degraded-host caveat).
+
+Usage: python scripts/ab_distributed.py [--reps 3] [--n-histories 1000]
+       [--n-ops 1000] [--processes 2] [--identity-histories 64]
+       [--skip-e2e]
+"""
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--n-histories", type=int, default=1000)
+    ap.add_argument("--n-ops", type=int, default=1000)
+    ap.add_argument("--processes", type=int, default=2)
+    ap.add_argument("--identity-histories", type=int, default=64)
+    ap.add_argument("--skip-e2e", action="store_true")
+    args = ap.parse_args()
+
+    from jepsen_jgroups_raft_tpu.platform import pin_cpu
+
+    pin_cpu(8)  # the production CPU mesh the single-process bench uses
+
+    import random
+
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_encoded
+    from jepsen_jgroups_raft_tpu.history.packing import (
+        encode_history, pack_macro_batch, pack_macro_batch_shard)
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.models.register import CasRegister
+    from jepsen_jgroups_raft_tpu.parallel.distributed import shard_bounds
+
+    N = args.processes
+    rng = random.Random(3)
+    model = CasRegister()
+    hists = [random_valid_history(rng, "register", n_ops=args.n_ops,
+                                  n_procs=5, crash_p=0.05, max_crashes=3)
+             for _ in range(args.n_histories)]
+    encs = [encode_history(h, model) for h in hists]
+
+    # ---- part 1: per-host packing -----------------------------------
+    def pack_global():
+        t0 = time.perf_counter()
+        pack_macro_batch(encs)
+        return time.perf_counter() - t0
+
+    def pack_per_host():
+        walls = []
+        for p in range(N):
+            t0 = time.perf_counter()
+            pack_macro_batch_shard(encs, p, N)
+            walls.append(time.perf_counter() - t0)
+        return max(walls)  # concurrent shards: the slowest host gates
+
+    variants = {"global": pack_global, "per_host": pack_per_host}
+    for fn in variants.values():        # warm-up (allocator, caches)
+        fn()
+    times = {name: [] for name in variants}
+    for rep in range(args.reps):        # interleaved, order rotating
+        order = list(variants)[rep % 2:] + list(variants)[:rep % 2]
+        for name in order:
+            times[name].append(variants[name]())
+    for name, ts in times.items():
+        print({"variant": f"pack-{name}", "min_s": round(min(ts), 3),
+               "median_s": round(statistics.median(ts), 3),
+               "reps": [round(t, 3) for t in ts]})
+    pack_speedup = min(times["global"]) / min(times["per_host"])
+    print({"pack_speedup_at_min": round(pack_speedup, 3),
+           "pack_acceptance_1_3x": pack_speedup >= 1.3,
+           "n_shards": N})
+
+    # ---- part 2: row-shard verdict identity -------------------------
+    sub = encs[:args.identity_histories]
+    full = [r["valid?"] for r in check_encoded(sub, model)]
+    sharded = []
+    for p in range(N):
+        lo, hi = shard_bounds(len(sub), N, p)
+        sharded.extend(r["valid?"] for r in check_encoded(sub[lo:hi], model))
+    assert full == sharded, "row-shard verdicts diverged from full batch"
+    print({"identity_rows": len(sub), "verdicts_identical": True})
+
+    if args.skip_e2e:
+        return
+
+    # ---- part 3: end-to-end 1-process vs N-process ------------------
+    env = dict(os.environ)
+    env.update({"JGRAFT_BENCH_PLATFORM": "cpu", "JGRAFT_BENCH_REPS": "1",
+                "JGRAFT_AUTOTUNE": "0"})
+
+    def bench_once(distributed: bool) -> float:
+        cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+        if distributed:
+            cmd += ["--distributed", str(N)]
+        cmd += [str(args.n_histories), str(args.n_ops)]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=3600, env=env)
+        if out.returncode != 0:
+            raise RuntimeError(f"bench rc={out.returncode}: "
+                               f"{out.stderr[-1000:]}")
+        rows = [json.loads(ln) for ln in out.stdout.splitlines()
+                if ln.strip().startswith("{")]
+        [row] = [r for r in rows if r.get("metric") == "histories_per_sec"]
+        if "error" in row:
+            raise RuntimeError(f"bench error: {row['error']}")
+        return float(row["value"])
+
+    e2e = {"1p": [], f"{N}p": []}
+    for rep in range(args.reps):
+        order = [False, True] if rep % 2 == 0 else [True, False]
+        for dist in order:
+            key = f"{N}p" if dist else "1p"
+            e2e[key].append(bench_once(dist))
+            print({"variant": f"e2e-{key}", "rep": rep,
+                   "hist_per_s": round(e2e[key][-1], 2)})
+    best_1p, best_np = max(e2e["1p"]), max(e2e[f"{N}p"])
+    ratio = best_np / best_1p
+    print({"e2e_1p_hist_per_s": round(best_1p, 2),
+           f"e2e_{N}p_hist_per_s": round(best_np, 2),
+           "ratio": round(ratio, 3),
+           "e2e_acceptance_0_9x": ratio >= 0.9})
+
+
+if __name__ == "__main__":
+    main()

@@ -502,6 +502,12 @@ def test_platform_router_policy(monkeypatch):
         def devices(kind=None):
             return ["cpu0"]
 
+        @staticmethod
+        def local_devices(backend=None):
+            # the router probes THIS process's cpu devices (a global
+            # jax.devices("cpu") would list remote hosts' too)
+            return ["cpu0"]
+
     monkeypatch.setitem(__import__("sys").modules, "jax", FakeJax)
     assert lin._route_group_to_host(8, 32) is True        # tiny → host
     assert lin._route_group_to_host(1000, 2048) is False  # big → chip

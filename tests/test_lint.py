@@ -62,6 +62,15 @@ class TestSelfHosting:
             assert taxonomy.applies_to(
                 f"jepsen_jgroups_raft_tpu/{rel}"), rel
 
+    def test_taxonomy_scope_covers_distributed_tier(self):
+        # ISSUE-7 satellite: the distributed runtime's degrade paths
+        # are broad-except-shaped by design and must stay VISIBLE — a
+        # silent swallow there is the r01–r05 silent-CPU pattern at
+        # cluster scale.
+        for rel in ("parallel/distributed.py", "parallel/launch.py"):
+            assert taxonomy.applies_to(
+                f"jepsen_jgroups_raft_tpu/{rel}"), rel
+
     def test_serve_verdict_broad_except_would_fire(self):
         # the pre-fix _verdict shape (bare `except Exception: return
         # None`) is exactly a silent swallow; the fixed narrow catch

@@ -650,6 +650,38 @@ BAD_NEMESIS = ("class Nem:\n"
                "        return 'done'\n")
 
 
+class TestDistributedTierResourceScope:
+    """ISSUE-7 satellite: the multi-process launcher holds Popen
+    handles and the coordinator-port socket across exception paths —
+    a leaked child is a whole wedged interpreter, not just an fd."""
+
+    FILES = ("parallel/distributed.py", "parallel/launch.py")
+
+    def test_scope_covers_distributed_tier(self):
+        for f in self.FILES:
+            assert resource.applies_to(f"jepsen_jgroups_raft_tpu/{f}"), f
+
+    def test_distributed_tier_clean(self):
+        for f in self.FILES:
+            src = SourceFile.load(PKG / Path(f))
+            assert resource.analyze_source(src) == [], f
+
+    def test_launcher_unkilled_popen_shape_fires(self):
+        # launch_local_cluster adopts every child into `procs` inside
+        # a try whose finally kills survivors; a bare spawn whose
+        # readiness check can raise is exactly the leak shape the
+        # widened scope exists to catch — proves it is not vacuous.
+        bad = ("import subprocess\n"
+               "def spawn(cmd, env, check):\n"
+               "    p = subprocess.Popen(cmd, env=env)\n"
+               "    check(p)\n"
+               "    return p.pid\n")
+        src = SourceFile.from_text(
+            "jepsen_jgroups_raft_tpu/parallel/launch.py", bad)
+        assert any(f.rule == "flow-resource-leak"
+                   for f in resource.analyze_source(src))
+
+
 class TestCliFlow:
     def test_repo_is_clean_under_all_six(self):
         findings = cli.run(
