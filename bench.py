@@ -827,6 +827,7 @@ def run_service(platform_note: str) -> None:
     stamped, so service numbers are comparable across the known host
     drift exactly like the batch rows (CHANGES.md PR 3 note)."""
     import random as _random
+    import tempfile
     import threading
 
     import jax
@@ -834,6 +835,7 @@ def run_service(platform_note: str) -> None:
     from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
     from jepsen_jgroups_raft_tpu.service import (CheckingService,
                                                  ServiceClient, ServiceError,
+                                                 journal_enabled,
                                                  serve_in_thread)
 
     n_requests = int(os.environ.get("JGRAFT_SERVICE_BENCH_REQUESTS", "64"))
@@ -855,12 +857,26 @@ def run_service(platform_note: str) -> None:
     # the cache on every timed rep after the warm-up would measure the
     # fingerprint LRU, not the batching scheduler. The cache-hit path
     # has its own test coverage; this row measures real scheduling.
+    # journal_dir (ISSUE 8): the WAL rides a temp dir so the row
+    # measures the fsync-per-admission overhead WITHOUT trace-record
+    # IO; JGRAFT_SERVICE_JOURNAL=0 is the same-process A/B arm that
+    # prices the journal (journal_append_p50_ms stays absent).
+    journal_tmp = (tempfile.mkdtemp(prefix="graftd-bench-journal-")
+                   if journal_enabled() else None)
+
+    def rm_journal_tmp():
+        if journal_tmp:
+            import shutil
+
+            shutil.rmtree(journal_tmp, ignore_errors=True)
+
     service = CheckingService(store_root=None, name="graftd-bench",
-                              cache_capacity=0)
+                              cache_capacity=0, journal_dir=journal_tmp)
     httpd, port, _t = serve_in_thread(service)
     client_url = f"http://127.0.0.1:{port}"
     _CLEANUP.append(httpd.server_close)
     _CLEANUP.append(service.shutdown)
+    _CLEANUP.append(rm_journal_tmp)
 
     def wave():
         """One rep: n_requests submitted from n_clients threads, every
@@ -922,8 +938,10 @@ def run_service(platform_note: str) -> None:
     httpd.shutdown()
     httpd.server_close()
     service.shutdown(wait=True)
+    rm_journal_tmp()
     _CLEANUP.remove(httpd.server_close)
     _CLEANUP.remove(service.shutdown)
+    _CLEANUP.remove(rm_journal_tmp)
 
     latencies.sort()
     p50 = latencies[len(latencies) // 2] if latencies else 0.0
@@ -956,6 +974,15 @@ def run_service(platform_note: str) -> None:
         # service-health evidence for the whole bench run.
         "degraded_batches": stats["degraded_batches"],
         "worker_restarts": stats["worker_restarts"],
+        # ISSUE-8 durability evidence: whether the WAL was on, what the
+        # fsync'd append costs at admission (p50 ms over the run), and
+        # how many requests this daemon replayed at boot (0 here — the
+        # bench store is fresh; the field exists so ops dashboards and
+        # the chaos harness read one schema). A/B the journal cost
+        # same-process via JGRAFT_SERVICE_JOURNAL=0.
+        "journal_enabled": stats["journal_enabled"],
+        "journal_append_p50_ms": stats.get("journal_append_p50_ms"),
+        "recovered_requests": stats["recovered_requests"],
         # Same host-drift armor as the batch rows (ISSUE-4 satellites):
         # best rep + full spread + cold/warm split + host fingerprint.
         "rep_times_s": [round(t, 3) for t in rep_times],

@@ -138,7 +138,13 @@ def main(argv=None) -> int:
         return 2
 
     paths = args.paths or [str(repo_root() / "jepsen_jgroups_raft_tpu"),
-                           str(repo_root() / "native" / "src")]
+                           str(repo_root() / "native" / "src"),
+                           # in-scope scripts (ISSUE 8): the chaos
+                           # harness is gated like the service tier it
+                           # exercises; absent on partial checkouts.
+                           *(str(p) for p in
+                             [repo_root() / "scripts" / "chaos_graftd.py"]
+                             if p.exists())]
     findings = run(paths, rules, vmem_budget=args.vmem_budget)
 
     fps = report.fingerprints(findings, repo_root())

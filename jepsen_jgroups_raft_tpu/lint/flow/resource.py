@@ -65,7 +65,11 @@ SCAN_FILES = ("deploy/ssh.py", "deploy/local.py", "core/runner.py",
               # across exception paths (a leaked child is a whole
               # wedged interpreter, not just an fd), and distributed.py
               # owns the cluster runtime handles.
-              "parallel/distributed.py", "parallel/launch.py")
+              "parallel/distributed.py", "parallel/launch.py",
+              # ISSUE-8 chaos harness: spawns daemon subprocesses and
+              # sockets across kill/restart cycles — a leaked daemon
+              # outlives the harness and squats its port/store.
+              "scripts/chaos_graftd.py")
 
 #: The service tier (ISSUE-5) is scanned wholesale: graftd holds queue
 #: entries, per-call client sockets, trace file handles, and worker

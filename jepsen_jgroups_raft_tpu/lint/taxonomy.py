@@ -65,9 +65,13 @@ LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
 #: env, failed init, unsupported collectives) are broad-except-shaped
 #: by design and must stay VISIBLE — a silent swallow there is exactly
 #: the r01–r05 silent-CPU pattern at cluster scale.
+#: scripts/chaos_graftd.py rides along (ISSUE 8): a chaos harness that
+#: silently swallows an exception reports invariants it never checked —
+#: its handlers must be narrow or visible like the daemon's own.
 SCAN_PREFIXES = ("client/", "workload/", "deploy/", "service/")
 SCAN_FILES = ("core/runner.py", "native/client.py", "core/serve.py",
-              "parallel/distributed.py", "parallel/launch.py")
+              "parallel/distributed.py", "parallel/launch.py",
+              "scripts/chaos_graftd.py")
 
 
 def applies_to(relpath: str) -> bool:

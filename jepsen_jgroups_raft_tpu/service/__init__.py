@@ -13,14 +13,20 @@ macro compaction) across many independent submissions:
 * scheduler.py — cross-request shape-bucket batching over
                  `checker.linearizable.check_encoded`, deadline/aging
                  ordering, per-request cancellation, degrade-to-CPU.
-* daemon.py    — CheckingService: supervised worker, stats, store/
-                 trace records.
+* journal.py   — write-ahead admission journal (ISSUE 8): fsync'd
+                 submit records before the 202, terminal markers,
+                 bounded compaction, loud torn-tail replay.
+* daemon.py    — CheckingService: supervised worker, crash recovery,
+                 poison-batch quarantine + hung-batch watchdog, stats,
+                 store/ trace records.
 * http.py      — stdlib HTTP+JSON surface (`serve-checker` CLI).
-* client.py    — tenant-side client (tests, bench --service).
+* client.py    — tenant-side client with idempotent retry/backoff
+                 (tests, bench --service, scripts/chaos_graftd.py).
 """
 
-from .admission import QueueFull  # noqa: F401
+from .admission import QueueFull, ServiceStopped  # noqa: F401
 from .client import ServiceClient, ServiceError  # noqa: F401
 from .daemon import CheckingService  # noqa: F401
 from .http import make_server, serve_checker, serve_in_thread  # noqa: F401
+from .journal import AdmissionJournal, journal_enabled  # noqa: F401
 from .request import CheckRequest  # noqa: F401

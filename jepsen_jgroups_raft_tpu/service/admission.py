@@ -116,16 +116,18 @@ class AdmissionQueue:
              timeout: float) -> List[CheckRequest]:
         """Block up to `timeout` for `chooser` to select a non-empty
         batch from the pending snapshot; selected requests are removed
-        atomically. Cancelled entries are pruned (and reported via
-        on_prune) before every selection, so a cancel between poll
-        rounds never reaches execution."""
+        atomically. Cancelled entries — and already-terminal ones (the
+        stale twin of a watchdog requeue whose other copy finished
+        first) — are pruned (and reported via on_prune) before every
+        selection, so neither ever reaches execution."""
         deadline = time.monotonic() + timeout
         while True:
             pruned: List[CheckRequest] = []
             with self._cond:
                 keep = []
                 for r in self._pending:
-                    (pruned if r.cancelled.is_set() else keep).append(r)
+                    (pruned if r.cancelled.is_set() or r.terminal
+                     else keep).append(r)
                 self._pending = keep
                 chosen = chooser(list(self._pending)) if self._pending else []
                 for r in chosen:
@@ -146,8 +148,13 @@ class AdmissionQueue:
         admitted once, and dropping them on a crash is the exact loss
         mode the supervisor exists to prevent."""
         with self._cond:
+            # Identity-deduped: a watchdog strike can requeue a request
+            # whose strike-one copy is still sitting in the queue.
             self._pending[:0] = [r for r in reqs
-                                 if not r.cancelled.is_set()]
+                                 if not r.cancelled.is_set()
+                                 and not r.terminal
+                                 and all(r is not p
+                                         for p in self._pending)]
             self._cond.notify_all()
 
 

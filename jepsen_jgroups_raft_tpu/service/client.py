@@ -5,19 +5,39 @@ The tenant-side counterpart of service/http.py: tests, the bench's
 of hand-rolling requests. One connection per call (the daemon is
 ThreadingHTTPServer; connection reuse buys nothing at this scale and a
 stateless client survives daemon restarts for free).
+
+Retry discipline (ISSUE 8): submission is IDEMPOTENT server-side — a
+resubmitted fingerprint attaches to the live request or hits the result
+cache instead of double-checking — so the client can safely retry the
+failure modes a durable daemon actually produces: 429 backpressure
+(honoring the daemon's Retry-After), 503 while a restart is in flight
+(same), and connection-level failures (daemon SIGKILL'd mid-call; the
+request may or may not have been journaled — resubmitting is safe
+either way, which is the whole point of idempotency). Backoff is capped
+exponential with full jitter and a max-attempts cap; callers that want
+the old single-shot behavior pass ``max_attempts=1``.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import time
-from http.client import HTTPConnection
+from http.client import HTTPConnection, HTTPException
 from typing import Optional, Sequence
+
+#: Connection-level failures safe to retry once submission is
+#: idempotent (refused/reset/timeout — the daemon-restart signatures).
+RETRYABLE_CONN_ERRORS = (ConnectionError, HTTPException, TimeoutError,
+                         OSError)
+
+#: HTTP statuses that carry a retry_after_s hint and mean "try later".
+RETRYABLE_STATUSES = (429, 503)
 
 
 class ServiceError(Exception):
     """Non-2xx daemon answer. `status` is the HTTP code; `payload` the
-    decoded JSON body (carries `retry_after_s` on 429)."""
+    decoded JSON body (carries `retry_after_s` on 429 AND 503)."""
 
     def __init__(self, status: int, payload: dict):
         super().__init__(f"HTTP {status}: {payload.get('error', payload)}")
@@ -30,17 +50,43 @@ class ServiceError(Exception):
         return float(v) if v is not None else None
 
 
+def backoff_delay(attempt: int, base_s: float, cap_s: float,
+                  retry_after_s: Optional[float] = None,
+                  rng: Optional[random.Random] = None) -> float:
+    """Delay before retry `attempt` (1-based): capped exponential with
+    FULL jitter — `uniform(0, min(cap, base·2^(attempt-1)))` — so a
+    retry storm from many clients decorrelates instead of re-arriving
+    in lockstep. A server-provided Retry-After is a floor, not a
+    suggestion: we never come back EARLIER than the daemon asked, and
+    jitter is added on top (still capped) so even Retry-After herds
+    spread out."""
+    r = (rng or random).uniform(0.0, 1.0)
+    exp = min(cap_s, base_s * (2.0 ** max(0, attempt - 1)))
+    delay = r * exp
+    if retry_after_s is not None:
+        delay = min(retry_after_s + r * exp, retry_after_s + cap_s)
+        delay = max(delay, retry_after_s)
+    return delay
+
+
 class ServiceClient:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 max_attempts: int = 4, backoff_base_s: float = 0.1,
+                 backoff_cap_s: float = 5.0,
+                 rng: Optional[random.Random] = None):
         # base_url: http://host:port (path prefixes unsupported — the
         # daemon serves at the root, like core/serve.py).
         if "://" in base_url:
             base_url = base_url.split("://", 1)[1]
         self.netloc = base_url.rstrip("/")
         self.timeout = timeout
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._rng = rng or random.Random()
 
-    def _call(self, method: str, path: str,
-              body: Optional[dict] = None) -> dict:
+    def _call_once(self, method: str, path: str,
+                   body: Optional[dict] = None) -> dict:
         conn = HTTPConnection(self.netloc, timeout=self.timeout)
         try:
             payload = json.dumps(body).encode() if body is not None else None
@@ -54,26 +100,59 @@ class ServiceClient:
             raise ServiceError(resp.status, data)
         return data
 
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              retry: bool = True) -> dict:
+        """One logical call with the retry discipline (module
+        docstring). `retry=False` restores single-shot semantics for
+        calls the caller wants to fail fast."""
+        attempts = self.max_attempts if retry else 1
+        last: Exception = None
+        for attempt in range(1, attempts + 1):
+            try:
+                return self._call_once(method, path, body)
+            except ServiceError as e:
+                if e.status not in RETRYABLE_STATUSES or attempt == attempts:
+                    raise
+                last = e
+                delay = backoff_delay(attempt, self.backoff_base_s,
+                                      self.backoff_cap_s,
+                                      retry_after_s=e.retry_after_s,
+                                      rng=self._rng)
+            except RETRYABLE_CONN_ERRORS as e:
+                # Safe because /submit is idempotent (fingerprint
+                # attach / cache hit) and every other endpoint is a
+                # read or an idempotent cancel.
+                if attempt == attempts:
+                    raise
+                last = e
+                delay = backoff_delay(attempt, self.backoff_base_s,
+                                      self.backoff_cap_s, rng=self._rng)
+            time.sleep(delay)
+        raise last  # unreachable; loop always returns or raises
+
     # ------------------------------------------------------- surface
 
     def submit(self, histories: Sequence, workload: str = "register",
                algorithm: str = "auto", deadline_ms: Optional[float] = None,
-               priority: int = 0) -> dict:
+               priority: int = 0, retry: bool = True) -> dict:
         """Submit histories (History objects or op-dict lists); returns
-        the daemon's request record ({"id", "status", ...}). Raises
-        ServiceError on 429 (read `.retry_after_s`) or 400."""
+        the daemon's request record ({"id", "status", ...}). Retries
+        429/503/connection failures with capped jittered backoff up to
+        `max_attempts` (safe: submission is idempotent); the final
+        failure raises ServiceError (read `.retry_after_s`) or the
+        connection error. `retry=False` fails fast."""
         rows = [h.to_dicts() if hasattr(h, "to_dicts") else list(h)
                 for h in histories]
         return self._call("POST", "/submit", {
             "workload": workload, "histories": rows,
             "algorithm": algorithm, "deadline_ms": deadline_ms,
-            "priority": priority})
+            "priority": priority}, retry=retry)
 
     def submit_run_dir(self, run_dir: str, workload: Optional[str] = None,
-                       algorithm: str = "auto") -> dict:
+                       algorithm: str = "auto", retry: bool = True) -> dict:
         return self._call("POST", "/submit", {
             "run_dir": str(run_dir), "workload": workload,
-            "algorithm": algorithm})
+            "algorithm": algorithm}, retry=retry)
 
     def result(self, request_id: str,
                wait_s: Optional[float] = None) -> dict:

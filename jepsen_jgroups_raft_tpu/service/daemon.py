@@ -37,6 +37,7 @@ from typing import Optional, Sequence
 
 from .admission import (AdmissionQueue, QueueFull, ResultCache,
                         ServiceStopped)
+from .journal import AdmissionJournal, decode_request, journal_enabled
 from .request import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, CheckRequest,
                       admit, admit_run_dir)
 from .scheduler import BatchScheduler, ShardLoads
@@ -120,6 +121,29 @@ def retain_capacity() -> int:
     return env_int("JGRAFT_SERVICE_RETAIN", 1024, minimum=1)
 
 
+def default_crash_cap() -> int:
+    """Executor deaths tolerated per request before quarantine
+    (JGRAFT_SERVICE_CRASH_CAP, default 2 — one batched attempt, one
+    solo attempt after the split). The unbounded alternative is the
+    ISSUE-8 failure mode: a deterministically-crashing batch re-kills
+    the supervised worker forever."""
+    from ..platform import env_int
+
+    return env_int("JGRAFT_SERVICE_CRASH_CAP", 2, minimum=1)
+
+
+def default_watchdog_margin() -> float:
+    """Hung-batch watchdog margin in seconds past a request's DEADLINE
+    (JGRAFT_SERVICE_WATCHDOG_S, default 30; 0 disables). Strike one at
+    deadline+margin requeues the request; strike two at
+    deadline+2·margin retries it solo via the bounded host ladder
+    (`check_encoded_host`) so a wedged device launch can never park a
+    shard queue forever."""
+    from ..platform import env_int
+
+    return float(env_int("JGRAFT_SERVICE_WATCHDOG_S", 30, minimum=0))
+
+
 class CheckingService:
     """The daemon. `start()` spawns the supervised worker; `submit*`
     admit requests (raising `admission.QueueFull` past capacity);
@@ -133,6 +157,9 @@ class CheckingService:
                  cache_capacity: Optional[int] = None,
                  check_fn=None, host_fallback=None,
                  n_workers: Optional[int] = None,
+                 journal_dir: Optional[str] = None,
+                 crash_cap: Optional[int] = None,
+                 watchdog_margin_s: Optional[float] = None,
                  autostart: bool = True):
         self.name = name
         self.store_root = Path(store_root) if store_root else None
@@ -153,7 +180,11 @@ class CheckingService:
         self._shard_queues: list = [_ShardQueue()
                                     for _ in range(self.n_workers)]
         self._executors: list = [None] * self.n_workers
-        self._inflight_by_shard: dict = {}
+        #: thread id → the batch that thread popped and is executing.
+        #: Keyed by THREAD (not shard): after a watchdog replacement
+        #: the zombie and its successor coexist briefly, and the
+        #: zombie's cleanup must not clobber the successor's record.
+        self._inflight_by_thread: dict = {}
         self._requests: dict = {}
         self._terminal: deque = deque()  # finished ids, oldest first
         self._retain = retain_capacity()
@@ -161,17 +192,118 @@ class CheckingService:
         self._stop = threading.Event()
         self._started = False
         self._worker: Optional[threading.Thread] = None
-        self._inflight: list = []
         self._latencies: deque = deque(maxlen=LATENCY_WINDOW)
+        # Durability/resilience tier (ISSUE 8).
+        self.crash_cap = (crash_cap if crash_cap is not None
+                          else default_crash_cap())
+        self.watchdog_margin_s = (
+            watchdog_margin_s if watchdog_margin_s is not None
+            else default_watchdog_margin())
+        self._watchdog: Optional[threading.Thread] = None
+        #: fingerprint → live (queued/running) primary request, and
+        #: primary id → attached idempotent-duplicate followers.
+        self._primary_by_fp: dict = {}
+        self._followers: dict = {}
+        self._journal: Optional[AdmissionJournal] = None
+        if journal_enabled() and (journal_dir or self.store_root):
+            root = (Path(journal_dir) if journal_dir
+                    else self.store_root / self.name / "journal")
+            self._journal = AdmissionJournal(root, retain=self._retain)
         self._stats = {
             "submitted": 0, "completed": 0, "failed": 0, "cancelled": 0,
             "rejected": 0, "cache_hits": 0, "batches": 0, "batch_rows": 0,
             "batched_requests": 0, "degraded_batches": 0,
             "max_queue_depth": 0, "worker_restarts": 0, "trace_errors": 0,
+            "recovered_requests": 0, "attached_requests": 0,
+            "quarantined": 0, "watchdog_requeues": 0,
         }
         self._service_time_s = 1.0  # EWMA of per-request service time
+        if self._journal is not None:
+            self._recover()
         if autostart:
             self.start()
+
+    # ------------------------------------------------------- recovery
+
+    def _recover(self) -> None:
+        """Crash recovery (ISSUE 8): replay the admission journal.
+        Finished entries are restored into the retention window (their
+        clean results also re-warm the fingerprint cache); unfinished
+        entries re-enter the admission queue in original deadline
+        order, except that a replayed duplicate whose fingerprint now
+        cache-hits (or matches an earlier replayed primary) short-
+        circuits instead of re-executing."""
+        try:
+            replayed = self._journal.replay()
+        except OSError:
+            LOG.warning("%s journal replay failed; starting with an "
+                        "empty queue", self.name, exc_info=True)
+            return
+        for sub, term in replayed["finished"]:
+            try:
+                req = decode_request(sub)
+            except (ValueError, KeyError, TypeError):
+                continue
+            req._journaled = True   # already has its terminal marker
+            req._retired = True     # do not re-journal / re-resolve
+            req.replayed = True
+            status = term.get("status", FAILED)
+            results = term.get("results")
+            if status == DONE and results is None:
+                # The verdict existed but was not persisted (degraded
+                # runs never are): restored as FAILED so the client
+                # resubmits for a fresh one instead of reading a DONE
+                # with no results.
+                status, error = FAILED, ("verdict was not persisted "
+                                         "across restart; resubmit")
+            else:
+                error = term.get("error")
+            req.finish(status, results=results, error=error)
+            with self._lock:
+                self._requests[req.id] = req
+                self._terminal.append(req.id)
+            if status == DONE and results is not None \
+                    and len(results) == req.n_rows:
+                self.cache.put(req.fingerprint, results)
+        recovered = []
+        for req in replayed["unfinished"]:
+            req._journaled = True
+            with self._lock:
+                self._requests[req.id] = req
+            cached = self.cache.get(req.fingerprint)
+            if cached is not None and len(cached) == req.n_rows:
+                req.cached = True
+                req.finish(DONE, results=cached)
+                self._count("cache_hits", "completed")
+                self._retire(req)
+                continue
+            with self._lock:
+                primary = self._primary_by_fp.get(req.fingerprint)
+                if primary is not None and not primary.terminal:
+                    # replayed duplicate: attach, don't re-execute
+                    req.attached_to = primary.id
+                    self._followers.setdefault(primary.id,
+                                               []).append(req)
+                    self._stats["attached_requests"] += 1
+                    self._stats["recovered_requests"] += 1
+                    continue
+                self._primary_by_fp[req.fingerprint] = req
+            recovered.append(req)
+        if recovered:
+            # requeue(): replayed entries were admitted once already —
+            # capacity is not re-enforced against them (the same stance
+            # as worker-death recovery). replay() sorted by deadline.
+            self.queue.requeue(recovered)
+            with self._lock:
+                self._stats["recovered_requests"] += len(recovered)
+        with self._lock:
+            while len(self._terminal) > self._retain:
+                self._requests.pop(self._terminal.popleft(), None)
+        if recovered or replayed["finished"] or replayed["skipped"]:
+            LOG.info("%s journal replay: %d unfinished requeued, %d "
+                     "finished restored, %d corrupt/truncated record(s) "
+                     "skipped", self.name, len(recovered),
+                     len(replayed["finished"]), replayed["skipped"])
 
     # ------------------------------------------------------- lifecycle
 
@@ -207,6 +339,13 @@ class CheckingService:
                             daemon=True, name=f"{self.name}-shard{k}")
                         self._executors[k] = t
                         t.start()
+            if self.watchdog_margin_s > 0 and (
+                    self._watchdog is None
+                    or not self._watchdog.is_alive()):
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_loop, daemon=True,
+                    name=f"{self.name}-watchdog")
+                self._watchdog.start()
 
     def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
         """Stop the workers; queued requests are failed loudly (a
@@ -231,11 +370,16 @@ class CheckingService:
             for t in self._executors:
                 if t is not None and t.is_alive():
                     t.join(timeout)
+            wd = self._watchdog
+            if wd is not None and wd.is_alive():
+                wd.join(timeout)
         drained = self.queue.take(lambda pending: list(pending), timeout=0.0)
         for r in drained:
-            r.finish(FAILED, error="service shut down before execution")
-            self._count("failed")
+            if r.finish(FAILED, error="service shut down before execution"):
+                self._count("failed")
             self._retire(r)
+        if self._journal is not None:
+            self._journal.close()
 
     # --------------------------------------------------------- worker
 
@@ -248,24 +392,30 @@ class CheckingService:
             # respawn: queued tenants must survive a worker bug.
             LOG.exception("%s worker died; restarting", self.name)
             with self._lock:
-                inflight, self._inflight = self._inflight, []
-            unfinished = [r for r in inflight
-                          if r.status in (QUEUED, RUNNING)]
-            for r in unfinished:
-                r.status = QUEUED
-            self.queue.requeue(unfinished)
+                inflight = self._inflight_by_thread.pop(
+                    threading.get_ident(), [])
+            self._recover_crashed(inflight)
             self._count("worker_restarts")
             if not self._stop.is_set():
                 with self._lock:
-                    self._worker = None
+                    if self._worker is threading.current_thread():
+                        self._worker = None
                 self._ensure_worker()
+
+    def _abandoned(self) -> bool:
+        """True when THIS thread is no longer the daemon's dispatcher —
+        the watchdog replaced it while it was wedged on a hung batch
+        (ISSUE 8). The zombie finishes its in-flight no-op demux and
+        exits instead of competing with its replacement."""
+        return self._worker is not threading.current_thread()
 
     def _worker_loop(self) -> None:
         """Single-worker mode: form and execute inline (today's loop).
         Multi-worker mode (ISSUE 7): this loop is the DISPATCHER — it
         forms batches and routes each to the least-loaded shard's
         executor, so independent shape buckets run concurrently."""
-        while not self._stop.is_set():
+        tid = threading.get_ident()
+        while not self._stop.is_set() and not self._abandoned():
             batch = self.scheduler.next_batch(timeout=IDLE_POLL_S)
             if not batch:
                 continue
@@ -275,12 +425,15 @@ class CheckingService:
                              "loads_at_dispatch": self.shards.snapshot()}
                 self.shards.add(0, rows)
                 with self._lock:
-                    self._inflight = list(batch)
+                    self._inflight_by_thread[tid] = list(batch)
                 try:
                     self._run_batch(batch, placement)
-                finally:
+                    # cleared only on NORMAL completion: when execution
+                    # kills this thread, the record must survive for
+                    # the supervisor's crash recovery to requeue it
                     with self._lock:
-                        self._inflight = []
+                        self._inflight_by_thread.pop(tid, None)
+                finally:
                     self.shards.done(0, rows)
                 continue
             k = self.shards.least_loaded()
@@ -329,36 +482,153 @@ class CheckingService:
         executor requeues its popped-but-unfinished batch into the
         admission queue, bumps ``worker_restarts``, and is respawned —
         queued tenants must survive an executor bug."""
+        tid = threading.get_ident()
         try:
             q = self._shard_queues[k]
-            while not self._stop.is_set():
+            while not self._stop.is_set() \
+                    and self._executors[k] is threading.current_thread():
                 item = q.get(timeout=IDLE_POLL_S)
                 if item is None:
                     continue
                 batch, rows, placement = item
                 with self._lock:
-                    self._inflight_by_shard[k] = list(batch)
+                    self._inflight_by_thread[tid] = list(batch)
                 try:
                     self._run_batch(batch, placement)
-                finally:
+                    # normal-completion clear only; on death the
+                    # supervisor below pops and requeues this record
                     with self._lock:
-                        self._inflight_by_shard[k] = []
+                        self._inflight_by_thread.pop(tid, None)
+                finally:
                     self.shards.done(k, rows)
         except BaseException:
             LOG.exception("%s shard %d executor died; restarting",
                           self.name, k)
             with self._lock:
-                inflight = self._inflight_by_shard.pop(k, [])
-            unfinished = [r for r in inflight
-                          if r.status in (QUEUED, RUNNING)]
-            for r in unfinished:
-                r.status = QUEUED
-            self.queue.requeue(unfinished)
+                inflight = self._inflight_by_thread.pop(tid, [])
+            self._recover_crashed(inflight)
             self._count("worker_restarts")
             if not self._stop.is_set():
                 with self._lock:
-                    self._executors[k] = None
+                    if self._executors[k] is threading.current_thread():
+                        self._executors[k] = None
                 self._ensure_worker()
+
+    def _recover_crashed(self, inflight) -> None:
+        """Executor-death recovery with the poison-batch quarantine
+        (ISSUE 8). Every unfinished request of the dying batch gets a
+        crash strike. Below the cap it re-queues — SPLIT solo when the
+        batch had company, so a deterministically-crashing rider re-runs
+        alone and its innocent neighbors complete. At the cap
+        (JGRAFT_SERVICE_CRASH_CAP, default 2: one batched attempt + one
+        solo attempt) the request is FAILED individually — the bounded
+        alternative to respawning the worker forever."""
+        unfinished = [r for r in inflight
+                      if r.status in (QUEUED, RUNNING)]
+        survivors = []
+        for r in unfinished:
+            r.crash_count += 1
+            if r.crash_count >= self.crash_cap:
+                if r.finish(FAILED, error=(
+                        f"quarantined: executor died {r.crash_count}x "
+                        "with this request in flight "
+                        "(JGRAFT_SERVICE_CRASH_CAP)")):
+                    self._count("failed", "quarantined")
+                self._retire(r)
+                self._write_trace(r)
+            else:
+                # split: every survivor of a crashed batch is suspect
+                # and re-runs SOLO — an innocent rider completes alone,
+                # the poison one crashes alone and hits the cap without
+                # taking fresh arrivals down with it.
+                r.solo = True
+                r.status = QUEUED
+                survivors.append(r)
+        if survivors:
+            self.queue.requeue(survivors)
+
+    # ------------------------------------------------------- watchdog
+
+    def _watchdog_loop(self) -> None:
+        """Hung-batch watchdog (ISSUE 8): a RUNNING request that blows
+        past its deadline by the margin is requeued once (strike one —
+        maybe the shard was just busy); past 2x the margin it requeues
+        again solo with ``force_host`` set, so the retry runs the
+        bounded host ladder and the wedged device launch can never park
+        a shard queue forever. The stale execution keeps running — a
+        Python thread cannot be killed — but `finish` is first-wins, so
+        whichever copy completes first owns the client-visible result;
+        the loser demuxes into a no-op."""
+        poll = max(0.05, min(1.0, self.watchdog_margin_s / 4.0))
+        while not self._stop.wait(poll):
+            now = time.monotonic()
+            strikes = []
+            with self._lock:
+                reqs = list(self._requests.values())
+            for r in reqs:
+                if r.status != RUNNING or r.terminal \
+                        or r.cancelled.is_set():
+                    continue
+                # BOTH clocks must be overdue: the deadline (the
+                # client's latency contract) AND the current
+                # execution's own runtime. A request that spent its
+                # deadline waiting in a backlogged queue is late, not
+                # hung — striking it would duplicate work and demote
+                # healthy workers exactly when the daemon is busiest
+                # (metastable-overload amplification).
+                over = min(now - r.deadline, now - r.run_started)
+                if over <= self.watchdog_margin_s:
+                    continue
+                if r.watchdog_hits == 0:
+                    r.watchdog_hits = 1
+                    strikes.append(r)
+                elif (r.watchdog_hits == 1
+                        and over > 2.0 * self.watchdog_margin_s):
+                    r.watchdog_hits = 2
+                    r.solo = True
+                    r.force_host = True
+                    strikes.append(r)
+            for r in strikes:
+                # status stays RUNNING on purpose: the wedged execution
+                # still holds the request, and strike two keys on that
+                # (a watchdog requeue is a retry of running work, not a
+                # return to the queued state).
+                self._count("watchdog_requeues")
+                LOG.warning("%s watchdog: request %s exceeded its "
+                            "deadline by >%gs (strike %d%s); requeued",
+                            self.name, r.id, self.watchdog_margin_s,
+                            r.watchdog_hits,
+                            ", forcing host ladder"
+                            if r.force_host else "")
+                if r.watchdog_hits >= 2:
+                    self._abandon_holder(r)
+            if strikes:
+                self.queue.requeue(strikes)
+
+    def _abandon_holder(self, req: CheckRequest) -> None:
+        """De-wedge: the worker thread wedged on `req`'s batch is
+        demoted (a Python thread cannot be killed) and a replacement is
+        spawned, so the requeued force-host retry — and every later
+        batch — has a live worker to run on. The zombie notices it was
+        replaced when (if) it unblocks, demuxes into first-wins no-ops,
+        and exits its loop."""
+        with self._lock:
+            holders = [tid for tid, batch
+                       in self._inflight_by_thread.items()
+                       if any(x is req for x in batch)]
+            if not holders:
+                return
+            for holder in holders:
+                if self._worker is not None \
+                        and self._worker.ident == holder:
+                    self._worker = None
+                for k, t in enumerate(self._executors):
+                    if t is not None and t.ident == holder:
+                        self._executors[k] = None
+        LOG.warning("%s watchdog: worker thread(s) %s wedged on request "
+                    "%s; spawning replacement", self.name, holders,
+                    req.id)
+        self._ensure_worker()
 
     # ------------------------------------------------------ admission
 
@@ -398,21 +668,49 @@ class CheckingService:
             self._retire(req)
             self._write_trace(req)
             return req
-        try:
-            self.queue.put(req, retry_after_s=self._retry_after())
-        except QueueFull:
-            with self._lock:
-                self._stats["rejected"] += 1
-                del self._requests[req.id]
-            raise
-        except ServiceStopped:
-            with self._lock:
-                del self._requests[req.id]
-            raise
-        self._count("submitted")
+        retry_after = self._retry_after()
         with self._lock:
-            self._stats["max_queue_depth"] = max(
-                self._stats["max_queue_depth"], self.queue.depth)
+            # Idempotent resubmission (ISSUE 8): a fingerprint that is
+            # already queued/running ATTACHES to the live primary
+            # instead of double-checking — the follower completes with
+            # the primary's results at `_resolve_followers`. Register /
+            # attach and queue-insert happen under ONE lock so a racing
+            # duplicate cannot slip between the check and the insert
+            # (queue.put's own lock nests safely: on_prune runs outside
+            # the queue condition).
+            # _journaled is marked BEFORE the request becomes visible
+            # to workers: a request fast enough to finish before this
+            # thread reaches append_submit below must still get its
+            # terminal marker from _retire (replay joins submit and
+            # terminal records by id, so their on-disk ORDER is free).
+            if self._journal is not None:
+                req._journaled = True
+            primary = self._primary_by_fp.get(req.fingerprint)
+            if primary is not None and not primary.terminal:
+                req.attached_to = primary.id
+                self._followers.setdefault(primary.id, []).append(req)
+                self._stats["submitted"] += 1
+                self._stats["attached_requests"] += 1
+            else:
+                self._primary_by_fp[req.fingerprint] = req
+                try:
+                    self.queue.put(req, retry_after_s=retry_after)
+                except (QueueFull, ServiceStopped) as e:
+                    if isinstance(e, QueueFull):
+                        self._stats["rejected"] += 1
+                    del self._requests[req.id]
+                    if self._primary_by_fp.get(req.fingerprint) is req:
+                        del self._primary_by_fp[req.fingerprint]
+                    raise
+                self._stats["submitted"] += 1
+                self._stats["max_queue_depth"] = max(
+                    self._stats["max_queue_depth"], self.queue.depth)
+        if self._journal is not None:
+            # Durability point: the WAL record is fsync'd BEFORE the
+            # 202 becomes visible to the client — an accepted request
+            # survives SIGKILL from here on. Followers are journaled
+            # too (each was individually promised a result).
+            self._journal.append_submit(req)
         self._ensure_worker()
         return req
 
@@ -439,10 +737,18 @@ class CheckingService:
             return None
         req.cancelled.set()
         if self.queue.remove(req):
-            req.finish(CANCELLED)
-            self._count("cancelled")
+            if req.finish(CANCELLED):
+                self._count("cancelled")
             self._retire(req)
             self._write_trace(req)
+        elif req.attached_to is not None:
+            # a follower is never in the queue; finalize it directly
+            # (first-wins: a racing primary resolution may have beaten
+            # the cancel, in which case the delivered result stands)
+            if req.finish(CANCELLED):
+                self._count("cancelled")
+                self._retire(req)
+                self._write_trace(req)
         return req.status
 
     def stats(self) -> dict:
@@ -464,6 +770,9 @@ class CheckingService:
         out["worker_alive"] = bool(worker is not None and worker.is_alive())
         out["workers"] = self.n_workers
         out["shard_loads"] = self.shards.snapshot()
+        out["journal_enabled"] = self._journal is not None
+        if self._journal is not None:
+            out.update(self._journal.stats())
         return out
 
     # ----------------------------------------------------- accounting
@@ -479,14 +788,74 @@ class CheckingService:
         the oldest finished requests (and their histories/encodings)
         are dropped from the registry past JGRAFT_SERVICE_RETAIN —
         in-flight requests are never evicted (only terminal ids enter
-        the window)."""
-        if getattr(req, "_retired", False):
-            return
-        req._retired = True
+        the window). Also the single terminal choke point for the
+        durability tier: the journal's terminal marker is appended here
+        (every finish path funnels through _retire), and attached
+        followers are resolved with the primary's outcome."""
+        with req._finish_lock:
+            if getattr(req, "_retired", False):
+                return
+            req._retired = True
+        if self._journal is not None and getattr(req, "_journaled", False):
+            self._journal.append_terminal(req)
+        self._resolve_followers(req)
         with self._lock:
             self._terminal.append(req.id)
             while len(self._terminal) > self._retain:
                 self._requests.pop(self._terminal.popleft(), None)
+
+    def _resolve_followers(self, req: CheckRequest) -> None:
+        """Deliver a terminal primary's outcome to its attached
+        idempotent duplicates (ISSUE 8). DONE/FAILED mirror onto every
+        follower (one execution, many 202s — the at-most-once-execution
+        half of idempotent resubmission). A CANCELLED primary must NOT
+        cancel its followers (one tenant's cancel is not another's):
+        the first live follower is promoted to primary and requeued,
+        the rest re-attach to it."""
+        with self._lock:
+            followers = self._followers.pop(req.id, [])
+            if self._primary_by_fp.get(req.fingerprint) is req:
+                del self._primary_by_fp[req.fingerprint]
+        if not followers:
+            return
+        if req.status == CANCELLED:
+            live = [f for f in followers
+                    if not f.terminal and not f.cancelled.is_set()]
+            for f in followers:
+                if f.cancelled.is_set() and f.finish(CANCELLED):
+                    self._count("cancelled")
+                    self._retire(f)
+                    self._write_trace(f)
+            if not live:
+                return
+            new_primary, rest = live[0], live[1:]
+            new_primary.attached_to = None
+            with self._lock:
+                # setdefault: a fresh submission may have claimed the
+                # fingerprint already; then the promoted follower just
+                # runs as its own (solo-keyed) primary.
+                self._primary_by_fp.setdefault(req.fingerprint,
+                                               new_primary)
+                for f in rest:
+                    f.attached_to = new_primary.id
+                    self._followers.setdefault(new_primary.id,
+                                               []).append(f)
+            self.queue.requeue([new_primary])
+            return
+        for f in followers:
+            if req.status == DONE and req.results is not None:
+                done = f.finish(DONE,
+                                results=[dict(r) for r in req.results])
+                if done:
+                    self._count("completed")
+                    self._observe_latency(f)
+            else:
+                if f.finish(FAILED, error=(
+                        f"primary request {req.id} "
+                        f"{req.status}: {req.error}")):
+                    self._count("failed")
+            self._retire(f)
+            self._write_trace(f)
 
     def _observe_latency(self, req: CheckRequest) -> None:
         dt = time.monotonic() - req.submitted
@@ -506,6 +875,16 @@ class CheckingService:
 
     def _account_requests(self, batch) -> None:
         for r in batch:
+            if not r.terminal:
+                # a watchdog-requeued twin of this batch is still
+                # running; the copy that finishes will account for it
+                continue
+            with r._finish_lock:
+                if getattr(r, "_accounted", False):
+                    # the stale twin of a watchdog requeue demuxed
+                    # after the fresh copy already counted this request
+                    continue
+                r._accounted = True
             if r.status == DONE:
                 self._count("completed")
                 self._observe_latency(r)
@@ -525,10 +904,11 @@ class CheckingService:
                 self._retire(r)
 
     def _finalize_pruned(self, req: CheckRequest) -> None:
-        """Queue pruned a cancelled entry before it reached a batch."""
+        """Queue pruned a cancelled (or already-terminal, e.g. a stale
+        watchdog twin) entry before it reached a batch."""
         if req.status not in (DONE, CANCELLED, FAILED):
-            req.finish(CANCELLED)
-            self._count("cancelled")
+            if req.finish(CANCELLED):
+                self._count("cancelled")
             self._retire(req)
             self._write_trace(req)
 

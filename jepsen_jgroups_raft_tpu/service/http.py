@@ -40,6 +40,13 @@ MAX_BODY_BYTES = 64 << 20
 #: be parked indefinitely by one client.
 MAX_WAIT_S = 60.0
 
+#: Retry-After hint on 503 ServiceStopped (ISSUE 8): a stopped daemon
+#: is usually a restart in flight (supervisor, chaos harness, rolling
+#: deploy), so the hint is restart-scale — clients with the idempotent
+#: retry discipline come back after the journal replay instead of
+#: erroring out of a survivable blip.
+STOPPED_RETRY_AFTER_S = 2.0
+
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -152,7 +159,12 @@ class _Handler(BaseHTTPRequestHandler):
                        {"Retry-After": str(max(1, int(e.retry_after_s)))})
             return
         except ServiceStopped as e:
-            self._send(503, {"error": str(e)})
+            # retry_after_s surfaced exactly like the 429 path, so
+            # ServiceClient's backoff treats both uniformly.
+            self._send(503, {"error": str(e),
+                             "retry_after_s": STOPPED_RETRY_AFTER_S},
+                       {"Retry-After":
+                        str(max(1, int(STOPPED_RETRY_AFTER_S)))})
             return
         except (ValueError, OSError, KeyError, TypeError) as e:
             # Malformed submissions (unknown workload, bad op rows,
@@ -183,9 +195,12 @@ def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
                               batch_wait=batch_wait,
                               n_workers=n_workers)
     httpd, bound = make_server(service, host, port)
+    recovered = service.stats()["recovered_requests"]
     print(f"graftd: checking service on http://{host}:{bound}/ "
           f"(queue={service.queue.capacity}, "
-          f"workers={service.n_workers}, store={store_root})")
+          f"workers={service.n_workers}, store={store_root}, "
+          f"journal={'on' if service._journal is not None else 'off'}"
+          + (f", recovered={recovered}" if recovered else "") + ")")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -119,11 +119,38 @@ class CheckRequest:
     cached: bool = False
     stats: dict = field(default_factory=dict)
     cancelled: threading.Event = field(default_factory=threading.Event)
+    #: durability/resilience lifecycle (ISSUE 8): executor deaths while
+    #: this request's batch was in flight (quarantined past the crash
+    #: cap), solo = excluded from coalescing (a poison batch is SPLIT so
+    #: innocent riders complete alone), force_host = the hung-batch
+    #: watchdog's second strike (re-run via check_encoded_host, never
+    #: the device path), watchdog_hits = strikes so far, replayed = came
+    #: back from the admission journal, attached_to = idempotent-dup
+    #: follower of the named primary request.
+    crash_count: int = 0
+    solo: bool = False
+    force_host: bool = False
+    watchdog_hits: int = 0
+    #: monotonic time the CURRENT execution began (scheduler.execute
+    #: stamps it beside the RUNNING flip). The watchdog strikes only
+    #: when the EXECUTION has been running past the margin — a request
+    #: that merely waited out its deadline in a backlogged queue is
+    #: late, not hung, and demoting healthy workers for it would
+    #: amplify the overload.
+    run_started: float = 0.0
+    replayed: bool = False
+    attached_to: Optional[str] = None
     _done: threading.Event = field(default_factory=threading.Event)
+    _finish_lock: threading.Lock = field(default_factory=threading.Lock)
 
     @property
     def n_rows(self) -> int:
         return len(self.encs)
+
+    @property
+    def terminal(self) -> bool:
+        """True once a terminal state landed (first-wins `finish`)."""
+        return self._done.is_set()
 
     def verdict(self):
         """Merged validity over the request's units (checker.base rule:
@@ -137,15 +164,24 @@ class CheckRequest:
         return self._done.wait(timeout)
 
     def finish(self, status: str, results: Optional[List[dict]] = None,
-               error: Optional[str] = None) -> None:
-        # Results/error land BEFORE the terminal status: a concurrent
-        # reader polling `status` (the HTTP surface's to_dict without
-        # wait_s) must never observe a terminal state whose results are
-        # still missing.
-        self.results = results
-        self.error = error
-        self.status = status
-        self._done.set()
+               error: Optional[str] = None) -> bool:
+        # FIRST terminal state wins (returns False on a late loser): a
+        # hung batch the watchdog requeued executes at-least-once, and
+        # whichever execution finishes first owns the client-visible
+        # result — the stale twin's finish must not overwrite it
+        # (at-most-once client-visible result, doc/checker-design.md
+        # §11). Results/error land BEFORE the terminal status: a
+        # concurrent reader polling `status` (the HTTP surface's
+        # to_dict without wait_s) must never observe a terminal state
+        # whose results are still missing.
+        with self._finish_lock:
+            if self._done.is_set():
+                return False
+            self.results = results
+            self.error = error
+            self.status = status
+            self._done.set()
+            return True
 
     def to_dict(self, include_results: bool = True) -> dict:
         d = {
@@ -160,6 +196,10 @@ class CheckRequest:
         }
         if self.error is not None:
             d["error"] = self.error
+        if self.replayed:
+            d["replayed"] = True
+        if self.attached_to is not None:
+            d["attached_to"] = self.attached_to
         if self.stats:
             d["service-stats"] = dict(self.stats)
         if include_results and self.results is not None:

@@ -71,6 +71,14 @@ PRIORITY_CREDIT_S = 1.0
 DEFAULT_MAX_BATCH_ROWS = 256
 
 
+class WatchdogDegrade(Exception):
+    """Internal signal: the hung-batch watchdog marked this retry
+    ``force_host`` — route it through the same degrade arm a dying
+    device path takes (host ladder + ``platform-degraded`` stamp, never
+    cached). Not a platform failure, so the process-wide degrade
+    registry is never written for it."""
+
+
 class ShardLoads:
     """Load accounting for graftd's worker shards (ISSUE 7 tentpole
     (c)). A shard is one execution lane — one worker thread per
@@ -173,14 +181,20 @@ class BatchScheduler:
 
     def _choose(self, pending: List[CheckRequest]) -> List[CheckRequest]:
         """Head request by effective deadline, plus every same-bucket
-        request that fits the row cap, in deadline order."""
+        request that fits the row cap, in deadline order. A ``solo``
+        request (poison-batch quarantine split, watchdog force-host
+        retry — ISSUE 8) never coalesces: it forms a singleton batch so
+        a deterministically-crashing rider cannot take innocent
+        neighbors down with it again."""
         ordered = sorted(pending, key=lambda r: (
             effective_deadline(r, self.aging_cap_s), r.submitted))
         head = ordered[0]
+        if head.solo:
+            return [head]
         sig = bucket_signature(head)
         batch, rows = [], 0
         for r in ordered:
-            if bucket_signature(r) != sig:
+            if r.solo or bucket_signature(r) != sig:
                 continue
             if batch and rows + r.n_rows > self.max_batch_rows:
                 break
@@ -201,7 +215,7 @@ class BatchScheduler:
         rows = sum(r.n_rows for r in batch)
         slack = head.deadline - time.monotonic()
         if (self.batch_wait > 0 and rows < self.max_batch_rows
-                and slack > self.batch_wait):
+                and not head.solo and slack > self.batch_wait):
             time.sleep(self.batch_wait)
             sig = bucket_signature(head)
 
@@ -210,7 +224,7 @@ class BatchScheduler:
                 for r in sorted(pending, key=lambda r: (
                         effective_deadline(r, self.aging_cap_s),
                         r.submitted)):
-                    if bucket_signature(r) != sig:
+                    if r.solo or bucket_signature(r) != sig:
                         continue
                     if extra_rows + r.n_rows > self.max_batch_rows:
                         break
@@ -237,10 +251,16 @@ class BatchScheduler:
         request's stats so a tenant's trace shows WHERE its launch ran."""
         live = []
         for r in batch:
+            if r.terminal:
+                # stale watchdog-requeue twin: the other copy already
+                # delivered the client-visible result (finish is
+                # first-wins) — nothing to execute or finalize.
+                continue
             if r.cancelled.is_set():
                 r.finish(CANCELLED)
             else:
                 r.status = RUNNING
+                r.run_started = time.monotonic()
                 live.append(r)
         if not live:
             return {"requests": 0, "rows": 0, "degraded": False,
@@ -265,6 +285,18 @@ class BatchScheduler:
         t0 = time.monotonic()
         with stats_scope(label=label) as scan:
             try:
+                if any(r.force_host for r in live):
+                    # Hung-batch watchdog second strike (ISSUE 8): the
+                    # first requeue re-ran the device path and it hung
+                    # again, so this retry goes STRAIGHT to the bounded
+                    # host ladder — a slower sound verdict instead of a
+                    # third chance to wedge a shard. Raising
+                    # WatchdogDegrade reuses the degrade arm below
+                    # verbatim (stamped degraded, therefore never
+                    # cached).
+                    raise WatchdogDegrade(
+                        "hung batch exceeded its deadline twice; "
+                        "watchdog forced the host ladder")
                 results = self.check_fn(encs, model, algorithm=algorithm)
             except Exception as e:
                 # Device path died mid-check (tunnel drop, backend

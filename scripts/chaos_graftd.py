@@ -1,0 +1,482 @@
+#!/usr/bin/env python
+"""graftd chaos harness (ISSUE 8): the Jepsen discipline applied to the
+checking service itself — drive sustained load while injecting the
+faults a production daemon actually meets, then assert the service
+invariants over the whole request history.
+
+Faults injected:
+  * SIGKILL of the daemon process mid-flight (+ restart on the same
+    store dir — the write-ahead journal's flagship case)
+  * worker-thread death (a BaseException escaping batch execution —
+    the poison-batch/crash-cap path)
+  * injected device failures (RuntimeError mid-check — the
+    degrade-to-host path)
+  * slow + failing journal IO (fsync raising / stalling — durability
+    degrades, availability must not)
+  * a hung batch (wedged device-launch stand-in — the watchdog path)
+
+Invariants asserted (the ISSUE-8 acceptance bar):
+  1. NOTHING ACCEPTED IS LOST: every request the daemon 202'd reaches a
+     terminal state, including across SIGKILL+restart.
+  2. RECOVERED VERDICTS ARE TRUE VERDICTS: every DONE verdict equals a
+     direct `check_histories` of the same history.
+  3. IDEMPOTENT RESUBMISSION EXECUTES AT MOST ONCE: a duplicate
+     fingerprint attaches or cache-hits; the observed execution count
+     does not grow.
+  4. NO WEDGED QUEUES: after every fault phase the daemon still serves
+     a fresh healthy submission and its queue drains.
+  Plus the ablation: JGRAFT_SERVICE_JOURNAL=0 restores the in-memory
+  daemon (no journal dir; a kill loses pending work — today's
+  behavior, on purpose).
+
+Usage:
+  python scripts/chaos_graftd.py --quick     # CI-sized (~2 min)
+  python scripts/chaos_graftd.py             # fuller soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from jepsen_jgroups_raft_tpu.platform import pin_cpu  # noqa: E402
+
+FAILURES: list = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def make_histories(rng: random.Random, n: int):
+    """(history, expected_valid) pairs — a mix of valid and impossible
+    histories with DISTINCT content (so each is its own fingerprint)."""
+    from jepsen_jgroups_raft_tpu.history.synth import (build_history,
+                                                       random_valid_history)
+
+    out = []
+    for i in range(n):
+        if i % 4 == 3:
+            rows = []
+            for j in range(19):
+                v = i * 100_000 + j
+                rows += [(0, "invoke", "write", v), (0, "ok", "write", v)]
+            rows += [(1, "invoke", "read", None), (1, "ok", "read", -7)]
+            out.append((build_history(rows), False))
+        else:
+            out.append((random_valid_history(
+                random.Random(rng.randrange(1 << 30)), "register",
+                n_ops=20, crash_p=0.0), True))
+    return out
+
+
+def direct_verdicts(pairs):
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+    from jepsen_jgroups_raft_tpu.models import CasRegister
+
+    got = [r["valid?"] for r in
+           check_histories([h for h, _ in pairs], CasRegister())]
+    want = [v for _, v in pairs]
+    check(got == want, f"direct check_histories agrees with synthesis "
+                       f"({sum(1 for v in want if v)} valid / "
+                       f"{len(want) - sum(1 for v in want if v)} invalid)")
+    return got
+
+
+# --------------------------------------------------- phase 1: SIGKILL
+
+
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_daemon(store: str, extra_env: dict, client_timeout=120.0):
+    from jepsen_jgroups_raft_tpu.service import ServiceClient
+
+    port = free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_jgroups_raft_tpu", "serve-checker",
+         "--store", store, "--host", "127.0.0.1", "--port", str(port)],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = ServiceClient(f"http://127.0.0.1:{port}", max_attempts=4,
+                               backoff_base_s=0.2, backoff_cap_s=1.0,
+                               timeout=client_timeout)
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                client.healthz()
+                return proc, client  # ownership transfers to the caller
+            except OSError:
+                if proc.poll() is not None:
+                    raise RuntimeError("daemon died on boot")
+                if time.monotonic() > deadline:
+                    raise RuntimeError("daemon did not come up in 120s")
+                time.sleep(0.3)
+    except Exception:
+        # a daemon we failed to hand to the caller must not outlive us
+        proc.kill()  # lint: allow(unhealed) — boot failed; no restart
+        raise
+
+
+def await_terminal(client, request_id: str, timeout_s: float) -> dict:
+    deadline = time.monotonic() + timeout_s
+    rec = client.result(request_id, wait_s=30.0)
+    while rec["status"] not in ("done", "failed", "cancelled"):
+        if time.monotonic() > deadline:
+            return rec
+        rec = client.result(request_id, wait_s=30.0)
+    return rec
+
+
+def phase_sigkill(n_requests: int, rng: random.Random) -> None:
+    print("phase 1: SIGKILL mid-flight + restart on the same store")
+    pairs = make_histories(rng, n_requests)
+    want = direct_verdicts(pairs)
+    with tempfile.TemporaryDirectory(prefix="chaos-graftd-") as store:
+        _phase_sigkill(store, pairs, want, rng)
+
+
+def _phase_sigkill(store, pairs, want, rng) -> None:
+    # long linger on the first daemon: every accepted request is still
+    # pending when the kill lands — the worst case for durability
+    proc, client = spawn_daemon(store,
+                                {"JGRAFT_SERVICE_BATCH_WAIT_MS": "30000"})
+    recs, dup_recs = [], []
+    try:
+        for h, _ in pairs:
+            recs.append(client.submit([h], workload="register"))
+        # idempotent duplicates of the first two payloads, accepted
+        # BEFORE the kill: they must attach (not re-execute) and also
+        # reach terminal states after the restart
+        for h, _ in pairs[:2]:
+            dup_recs.append(client.submit([h], workload="register"))
+        check(all(r["status"] == "queued" for r in recs),
+              f"{len(recs)} submissions accepted (202) and pending")
+        check(all(r.get("attached_to") for r in dup_recs),
+              "pre-kill duplicates attached to live primaries")
+    finally:
+        # the fault under test: heal = the restart two lines down
+        os.kill(proc.pid, signal.SIGKILL)  # lint: allow(unhealed)
+        proc.wait(30)
+    print("  ... SIGKILL delivered; restarting on the same store")
+
+    proc, client = spawn_daemon(store, {})
+    try:
+        outs = [await_terminal(client, r["id"], 600) for r in recs]
+        check(all(o["status"] == "done" for o in outs),
+              "invariant 1: every 202'd request reached a terminal "
+              "state after restart "
+              f"({[o['status'] for o in outs].count('done')}/{len(outs)} "
+              "done)")
+        got = [o.get("valid?") for o in outs]
+        check(got == want,
+              "invariant 2: recovered verdicts identical to direct "
+              "check_histories")
+        check(all(o.get("replayed") for o in outs),
+              "recovered requests are journal replays, not re-submissions")
+        dup_outs = [await_terminal(client, r["id"], 600)
+                    for r in dup_recs]
+        check([o.get("valid?") for o in dup_outs] == want[:2]
+              and all(o["status"] == "done" for o in dup_outs),
+              "pre-kill duplicates reached the same verdicts")
+        stats = client.stats()
+        check(stats["recovered_requests"] >= len(recs),
+              f"journal replayed {stats['recovered_requests']} requests")
+        check(stats["journal_enabled"] is True, "journal enabled")
+        # invariant 3 across the restart: resubmit an already-verified
+        # payload — it must short-circuit (cache hit), not re-execute
+        batches_before = client.stats()["batches"]
+        resub = client.submit([pairs[0][0]], workload="register")
+        check(resub.get("cached") is True,
+              "invariant 3: post-restart resubmission is a cache hit")
+        check(client.stats()["batches"] == batches_before,
+              "invariant 3: resubmission launched no new batch")
+        # invariant 4: the restarted daemon still serves fresh work
+        fresh = client.submit(
+            [make_histories(rng, 1)[0][0]], workload="register")
+        out = await_terminal(client, fresh["id"], 600)
+        check(out["status"] == "done",
+              "invariant 4: fresh submission after recovery completes")
+    finally:
+        proc.kill()  # lint: allow(unhealed) — phase over, harness exits
+        proc.wait(30)
+
+
+def phase_journal_off(rng: random.Random) -> None:
+    print("phase 2: JGRAFT_SERVICE_JOURNAL=0 ablation "
+          "(in-memory daemon, kill loses pending work — by design)")
+    pairs = make_histories(rng, 2)
+    with tempfile.TemporaryDirectory(
+            prefix="chaos-graftd-nojournal-") as store:
+        proc, client = spawn_daemon(store, {
+            "JGRAFT_SERVICE_JOURNAL": "0",
+            "JGRAFT_SERVICE_BATCH_WAIT_MS": "30000"})
+        try:
+            recs = [client.submit([h], workload="register")
+                    for h, _ in pairs]
+            check(client.stats()["journal_enabled"] is False,
+                  "journal reported disabled")
+        finally:
+            # the fault under test; heal = the restart below
+            os.kill(proc.pid, signal.SIGKILL)  # lint: allow(unhealed)
+            proc.wait(30)
+        check(not (Path(store) / "graftd" / "journal").exists(),
+              "no journal directory created")
+        proc, client = spawn_daemon(store, {"JGRAFT_SERVICE_JOURNAL": "0"})
+        try:
+            from jepsen_jgroups_raft_tpu.service import ServiceError
+
+            lost = 0
+            for r in recs:
+                try:
+                    client.result(r["id"])
+                except ServiceError as e:
+                    if e.status == 404:
+                        lost += 1
+            check(lost == len(recs)
+                  and client.stats()["recovered_requests"] == 0,
+                  "pending requests lost across the kill — today's "
+                  "in-memory behavior restored")
+        finally:
+            proc.kill()  # lint: allow(unhealed) — phase over
+            proc.wait(30)
+
+
+# ------------------------------------- phase 3: in-process fault storm
+
+
+class Boom(BaseException):
+    """Escapes `except Exception` — kills the executor thread."""
+
+
+def phase_fault_storm(n_requests: int, rng: random.Random) -> None:
+    """Worker-thread death + injected device failures + flaky/slow
+    journal IO under concurrent load; then a poison batch and a hung
+    batch. In-process so the faults can be injected surgically."""
+    print("phase 3: in-process fault storm "
+          "(worker death, device failure, journal IO faults)")
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_encoded
+    from jepsen_jgroups_raft_tpu.service import CheckingService
+
+    pairs = make_histories(rng, n_requests)
+    # deterministic fault plan, one entry consumed per check call
+    plan = [rng.random() for _ in range(n_requests * 4)]
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def chaotic_check(encs, model, algorithm="auto", **kw):
+        with lock:
+            i = calls["n"]
+            calls["n"] += 1
+        p = plan[i % len(plan)]
+        if p < 0.15:
+            raise Boom("injected worker death")
+        if p < 0.30:
+            raise RuntimeError("injected device failure")
+        return check_encoded(encs, model, algorithm=algorithm, **kw)
+
+    with tempfile.TemporaryDirectory(
+            prefix="chaos-graftd-storm-") as storm_root:
+        _fault_storm(storm_root, chaotic_check, pairs, rng)
+
+
+def _fault_storm(storm_root: str, chaotic_check, pairs,
+                 rng: random.Random) -> None:
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_encoded
+    from jepsen_jgroups_raft_tpu.service import CheckingService
+
+    svc = CheckingService(store_root=storm_root, batch_wait=0.0,
+                          check_fn=chaotic_check, crash_cap=3)
+
+    # flaky + slow journal IO, injected UNDER the journal's own OSError
+    # handling (_handle is called inside _append's try): durability
+    # degrades (journal_errors counts), admission must not
+    orig_handle = svc._journal._handle
+
+    def flaky_handle():
+        p = rng.random()
+        if p < 0.10:
+            time.sleep(0.05)  # slow disk
+        if p < 0.20:
+            raise OSError("injected journal IO failure")
+        return orig_handle()
+
+    svc._journal._handle = flaky_handle
+
+    from jepsen_jgroups_raft_tpu.service import QueueFull
+
+    reqs: list = []
+    try:
+        threads = []
+
+        def submitter(lo, hi):
+            for h, _ in pairs[lo:hi]:
+                while True:
+                    try:
+                        reqs.append(svc.submit([h], workload="register"))
+                        break
+                    except QueueFull as e:
+                        time.sleep(min(e.retry_after_s, 1.0))
+
+        step = max(1, len(pairs) // 4)
+        for lo in range(0, len(pairs), step):
+            t = threading.Thread(
+                target=submitter, args=(lo, lo + step), daemon=True)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(120)
+        check(len(reqs) == len(pairs),
+              f"all {len(pairs)} submissions admitted under fault storm")
+        done = all(r.wait(300) for r in reqs)
+        check(done, "invariant 1: every admitted request reached a "
+                    "terminal state under injected faults")
+        want_by_fp = {_fp(h): v for h, v in pairs}
+        mismatches = [r.id for r in reqs if r.status == "done"
+                      and r.verdict() is not want_by_fp[r.fingerprint]]
+        check(not mismatches,
+              "invariant 2: every DONE verdict matches the direct check"
+              + (f" (mismatched: {mismatches})" if mismatches else ""))
+        st = svc.stats()
+        check(st["journal_errors"] >= 1,
+              f"journal IO faults were absorbed, not fatal "
+              f"(journal_errors={st['journal_errors']})")
+        # invariant 4: daemon not wedged — healthy request completes
+        svc.scheduler.check_fn = check_encoded
+        svc._journal._handle = orig_handle
+        ok = svc.submit([make_histories(rng, 1)[0][0]],
+                        workload="register")
+        check(ok.wait(120) and ok.status == "done",
+              "invariant 4: daemon serves cleanly after the storm "
+              f"(worker_restarts={st['worker_restarts']}, "
+              f"degraded_batches={st['degraded_batches']}, "
+              f"quarantined={st['quarantined']})")
+        check(svc.queue.depth == 0, "invariant 4: queue fully drained")
+    finally:
+        svc.shutdown(wait=True)
+
+
+_FP_CACHE: dict = {}
+
+
+def _fp(history) -> str:
+    """Fingerprint a single-history register submission the same way
+    admission does (for matching storm results back to expectations)."""
+    key = id(history)
+    if key not in _FP_CACHE:
+        from jepsen_jgroups_raft_tpu.history.packing import encode_history
+        from jepsen_jgroups_raft_tpu.models import CasRegister
+        from jepsen_jgroups_raft_tpu.service.request import (
+            fingerprint_encodings)
+
+        m = CasRegister()
+        _FP_CACHE[key] = fingerprint_encodings(
+            m, "auto", [encode_history(history.client_ops(), m)])
+    return _FP_CACHE[key]
+
+
+def phase_poison_and_hang(rng: random.Random) -> None:
+    print("phase 4: poison batch + hung batch")
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_encoded
+    from jepsen_jgroups_raft_tpu.service import CheckingService
+
+    # poison: a deterministically executor-killing batch must be
+    # quarantined after the crash cap, not respawn the worker forever
+    def dying(encs, model, algorithm="auto", **kw):
+        raise Boom("deterministic poison")
+
+    svc = CheckingService(store_root=None, batch_wait=0.0,
+                          check_fn=dying, crash_cap=2)
+    poison = svc.submit([make_histories(rng, 1)[0][0]],
+                        workload="register")
+    got = poison.wait(120)
+    st = svc.stats()
+    check(got and poison.status == "failed"
+          and "quarantined" in (poison.error or ""),
+          f"poison batch quarantined after {st['worker_restarts']} "
+          "executor deaths (bounded, not forever)")
+    svc.scheduler.check_fn = check_encoded
+    ok = svc.submit([make_histories(rng, 1)[0][0]], workload="register")
+    check(ok.wait(120) and ok.status == "done",
+          "invariant 4: queue not wedged after quarantine")
+    svc.shutdown(wait=True)
+
+    # hung batch: the watchdog must rescue it via the host ladder
+    release = threading.Event()
+
+    def hanging(encs, model, algorithm="auto", **kw):
+        release.wait(60)
+        return check_encoded(encs, model, algorithm=algorithm, **kw)
+
+    svc = CheckingService(store_root=None, batch_wait=0.0,
+                          check_fn=hanging, watchdog_margin_s=0.3)
+    try:
+        req = svc.submit([make_histories(rng, 1)[0][0]],
+                         workload="register", deadline_ms=300)
+        got = req.wait(120)
+        check(got and req.status == "done"
+              and all("platform-degraded" in r for r in req.results),
+              "hung batch rescued by the watchdog via the host ladder "
+              f"(watchdog_requeues={svc.stats()['watchdog_requeues']})")
+        svc.scheduler.check_fn = check_encoded
+        ok = svc.submit([make_histories(rng, 1)[0][0]],
+                        workload="register")
+        check(ok.wait(120) and ok.status == "done",
+              "invariant 4: queue not wedged after the hang")
+    finally:
+        release.set()
+        svc.shutdown(wait=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized run (fewer requests, one kill cycle)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per phase (default 8 quick / 32 full)")
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--skip-subprocess", action="store_true",
+                    help="skip the SIGKILL phases (in-process only)")
+    args = ap.parse_args()
+    n = args.requests or (8 if args.quick else 32)
+    rng = random.Random(args.seed)
+
+    pin_cpu(8)
+    t0 = time.monotonic()
+    if not args.skip_subprocess:
+        phase_sigkill(n, rng)
+        phase_journal_off(rng)
+    phase_fault_storm(n, rng)
+    phase_poison_and_hang(rng)
+
+    wall = time.monotonic() - t0
+    print(json.dumps({"chaos_graftd": "fail" if FAILURES else "pass",
+                      "failures": FAILURES, "requests_per_phase": n,
+                      "wall_s": round(wall, 1)}))
+    return 1 if FAILURES else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,14 @@ class TestSelfHosting:
             assert taxonomy.applies_to(
                 f"jepsen_jgroups_raft_tpu/{rel}"), rel
 
+    def test_taxonomy_scope_covers_durability_tier(self):
+        # ISSUE-8 satellite: the journal (service/ prefix) and the
+        # chaos harness — a harness that silently swallows an
+        # exception reports invariants it never checked.
+        assert taxonomy.applies_to(
+            "jepsen_jgroups_raft_tpu/service/journal.py")
+        assert taxonomy.applies_to("scripts/chaos_graftd.py")
+
     def test_serve_verdict_broad_except_would_fire(self):
         # the pre-fix _verdict shape (bare `except Exception: return
         # None`) is exactly a silent swallow; the fixed narrow catch

@@ -666,6 +666,19 @@ class TestDistributedTierResourceScope:
             src = SourceFile.load(PKG / Path(f))
             assert resource.analyze_source(src) == [], f
 
+    def test_scope_covers_durability_tier(self):
+        # ISSUE-8 satellite: the journal rides the service/ prefix;
+        # the chaos harness (daemon subprocesses + sockets across
+        # kill/restart cycles) is scanned by explicit path — and both
+        # must be CLEAN (shipped baseline stays empty).
+        assert resource.applies_to(
+            "jepsen_jgroups_raft_tpu/service/journal.py")
+        assert resource.applies_to("scripts/chaos_graftd.py")
+        for path in (PKG / "service" / "journal.py",
+                     PKG.parent / "scripts" / "chaos_graftd.py"):
+            src = SourceFile.load(path)
+            assert resource.analyze_source(src) == [], str(path)
+
     def test_launcher_unkilled_popen_shape_fires(self):
         # launch_local_cluster adopts every child into `procs` inside
         # a try whose finally kills survivors; a bare spawn whose

@@ -43,15 +43,19 @@ def valid_hist(n_ops=20, seed=7):
                                 n_ops=n_ops, crash_p=0.0)
 
 
-def invalid_hist(n_ops=20):
+def invalid_hist(n_ops=20, salt=0):
     """Sequential writes ending in a read no write produced: no
     linearization exists. Sized like `valid_hist` (n_ops completed
     pairs) so valid and invalid submissions share one shape bucket —
-    the coalescing tests rely on riding the same launch."""
+    the coalescing tests rely on riding the same launch. `salt` makes
+    the CONTENT distinct across calls: byte-identical submissions now
+    attach idempotently (ISSUE 8) instead of executing separately, so
+    tests that want N independent requests need N fingerprints."""
     rows = []
     for i in range(n_ops - 1):
-        rows += [(0, "invoke", "write", i), (0, "ok", "write", i)]
-    rows += [(1, "invoke", "read", None), (1, "ok", "read", 10_000)]
+        v = salt * 100_000 + i
+        rows += [(0, "invoke", "write", v), (0, "ok", "write", v)]
+    rows += [(1, "invoke", "read", None), (1, "ok", "read", -7)]
     return H(*rows)
 
 
@@ -74,7 +78,7 @@ class TestBatching:
         """≥8 pending requests in one shape bucket ride ONE launch
         batch, and every demuxed verdict equals the direct check of the
         same history in isolation (acceptance bar)."""
-        hists = [valid_hist(seed=i) if i % 3 else invalid_hist()
+        hists = [valid_hist(seed=i) if i % 3 else invalid_hist(salt=i)
                  for i in range(8)]
         svc = make_service(autostart=False)
         reqs = [svc.submit([h], workload="register") for h in hists]
@@ -464,7 +468,10 @@ class TestTraceRecords:
         svc.start()
         wait_all([req])
         svc.shutdown(wait=True)
-        runs = list((tmp_path / "graftd").iterdir())
+        entries = list((tmp_path / "graftd").iterdir())
+        # the admission journal (ISSUE 8) lives next to the trace dirs
+        assert (tmp_path / "graftd" / "journal" / "wal.jsonl").exists()
+        runs = [d for d in entries if d.name != "journal"]
         assert len(runs) == 1 and req.id in runs[0].name
         rec = json.loads((runs[0] / "results.json").read_text())
         assert rec["valid?"] is True
