@@ -679,6 +679,39 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "platform_note": platform_note,
     })
 
+    if not dist_on and os.environ.get("JGRAFT_BENCH_CONSISTENCY",
+                                      "1") != "0":
+        # ISSUE-10 ablation row: the same batch re-verified at the
+        # `sequential` rung (relaxed precedence + greedy witness fast
+        # path). Capped at 256 rows so the row prices the rung, not the
+        # round; the real same-process acceptance A/B lives in
+        # scripts/ab_consistency.py. Single-process only (the sharded
+        # wavefront would barrier on every process emitting this row).
+        from jepsen_jgroups_raft_tpu.checker.linearizable import \
+            check_encoded
+
+        sub = encs[:min(len(encs), 256)]
+        check_encoded(sub, model, algorithm="jax",
+                      consistency="sequential")  # warm-up: compile
+        beat()
+        t0 = time.perf_counter()
+        rs = check_encoded(sub, model, algorithm="jax",
+                           consistency="sequential")
+        dt_seq = time.perf_counter() - t0
+        emit({
+            "metric": "sequential_rung_hist_per_sec",
+            "value": round(len(sub) / dt_seq, 2),
+            "unit": "hist/s",
+            "consistency": "sequential",
+            "rows": len(sub),
+            "greedy_certified_rows": sum(
+                1 for r in rs if r.get("algorithm") == "greedy-witness"),
+            "invalid_or_unknown": sum(
+                1 for r in rs if r.get("valid?") is not True),
+            "time_s": round(dt_seq, 3),
+            "platform": jax.devices()[0].platform,
+        })
+
 
 def autotune_report() -> dict:
     """Bench-JSON summary of the autotuner's engagement this process:
@@ -714,7 +747,9 @@ def run_suite(platform_note: str) -> None:
     from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
     from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
     from jepsen_jgroups_raft_tpu.models.counter import Counter
+    from jepsen_jgroups_raft_tpu.models.queuemodel import TicketQueue
     from jepsen_jgroups_raft_tpu.models.register import CasRegister
+    from jepsen_jgroups_raft_tpu.models.setmodel import GSet
 
     platform = jax.devices()[0].platform
     emit({"suite_platform": platform, "note": platform_note,
@@ -727,7 +762,7 @@ def run_suite(platform_note: str) -> None:
     def sz(n, floor=1):
         return max(floor, int(n * scale))
 
-    def timed(name, model, hists):
+    def timed(name, model, hists, model_family=None, consistency=None):
         from jepsen_jgroups_raft_tpu.checker.schedule import consume_stats
 
         # No pinned capacity: the checker auto-routes (dense kernel where
@@ -736,19 +771,22 @@ def run_suite(platform_note: str) -> None:
         # uses — warming on a subset picks a different (batch-bucket,
         # window) kernel-cache entry and the timed run would pay the
         # multi-second XLA compile.
-        check_histories(hists, model, algorithm="jax")
+        kw = {"consistency": consistency} if consistency else {}
+        check_histories(hists, model, algorithm="jax", **kw)
         beat()
         consume_stats()  # drop the warm-up's chunked-scan counters
         # Best-of-3 like the north-star bench: single-shot suite rows
         # measured the tunnel's mood (config 4 read 3.08 hist/s in the
         # same session a warm in-process A/B measured 9.5).
         rs, times = best_of(
-            lambda: check_histories(hists, model, algorithm="jax"))
+            lambda: check_histories(hists, model, algorithm="jax", **kw))
         dt = min(times)
         scan = consume_stats()  # summed over the timed reps
         bad = [r for r in rs if r["valid?"] is not True]
         kernels = sorted({r.get("kernel", r["algorithm"]) for r in rs})
         emit({"config": name, "histories": len(hists),
+              "model_family": model_family or model.name,
+              **({"consistency": consistency} if consistency else {}),
               "time_s": round(dt, 3),
               "histories_per_sec": round(len(hists) / dt, 2),
               "invalid_or_unknown": len(bad), "kernel": kernels,
@@ -757,6 +795,7 @@ def run_suite(platform_note: str) -> None:
               "evicted_rows": scan["evicted_rows"],
               "chunks_run": scan["chunks_run"],
               "pipeline_overlap_s": round(scan["pipeline_overlap_s"], 3),
+              "host_fingerprint": host_fingerprint(),
               "platform": platform})
 
     rng = _random.Random(3)
@@ -801,16 +840,38 @@ def run_suite(platform_note: str) -> None:
           **cold_warm(times),
           "platform": platform})
 
-    # 4: independent multi-key, 10k ops per history.
+    # 4: independent multi-key, 10k ops per history (the cross-key
+    # batch axis of checker/independent.check_keyed).
     hs = [random_valid_history(rng, "register", n_ops=sz(10_000, 500),
                                n_procs=5, crash_p=0.02, max_crashes=4)
           for _ in range(sz(16, 2))]
-    timed("4: independent 16x10k", CasRegister(), hs)
+    timed("4: independent 16x10k", CasRegister(), hs,
+          model_family="multi-register")
 
     # 5: long-history stress — one 100k-op register history.
     h = random_valid_history(rng, "register", n_ops=sz(100_000, 2000),
                              n_procs=5, crash_p=0.01, max_crashes=4)
     timed("5: single 100k-op history", CasRegister(), [h])
+
+    # 6-7: scenario tier (ISSUE 10) — the model-family dimension covers
+    # set and queue from round one, same shape discipline as config 1.
+    set_hs = [random_valid_history(rng, "set", n_ops=sz(1000, 50),
+                                   n_procs=5, crash_p=0.05, max_crashes=3,
+                                   value_range=32)
+              for _ in range(sz(1000, 8))]
+    timed("6: set 1000x1k", GSet(), set_hs)
+
+    hs = [random_valid_history(rng, "queue", n_ops=sz(1000, 50),
+                               n_procs=5, crash_p=0.05, max_crashes=3)
+          for _ in range(sz(1000, 8))]
+    timed("7: queue 1000x1k", TicketQueue(), hs)
+
+    # 8: weaker-consistency ablation — THE SAME batch as config 6 at
+    # the sequential rung (greedy witness + relaxed kernels). Read next
+    # to config 6: the rung's whole point is deciding the same rows
+    # cheaper.
+    timed("8: set 1000x1k @sequential", GSet(), set_hs,
+          consistency="sequential")
 
 
 def run_service(platform_note: str) -> None:

@@ -1,0 +1,357 @@
+"""Weaker-consistency rungs: relaxed-precedence scans over the packed
+event tensors.
+
+The ladder below full linearizability (ROADMAP item 4) is built from ONE
+observation: the packed event stream (history/packing.py) encodes ALL
+real-time precedence through FORCE placement — an op must linearize
+between its OPEN and its FORCE. A weaker consistency rung is therefore a
+*stream transform*, not a new engine: defer each op's FORCE along the
+axis the rung cares about and re-run the identical frontier machinery
+(dense/mask/sort kernels, macro compaction, chunked eviction, autotune
+bucketing, graftd coalescing — all of it consumes `EncodedHistory` and
+applies unchanged).
+
+Rungs (strong → weak), by FORCE placement:
+
+  ``linearizable``  — FORCE at the op's real-time completion (the
+                      untouched encoding).
+  ``sequential``    — FORCE deferred to just before the same process's
+                      NEXT op opens (or end of stream): cross-process
+                      real-time edges are dropped, per-process program
+                      order is kept.
+  ``session``       — (monotonic-reads tier) FORCE deferred to just
+                      before the same process's next *read* opens
+                      (``Model.readonly_fcodes``), else end of stream:
+                      only reads must observe their session's earlier
+                      ops.
+
+Soundness (doc/checker-design.md §12 for the full argument):
+
+  * Monotone relaxation: every rung only moves FORCEs later (clamped to
+    ``max(original, deferred)``), so any linearization witness survives
+    each step down the ladder — a history passing linearizability
+    passes every weaker rung, and a FAIL at a weak rung certifies
+    non-linearizability (the rung-ordering property tests pin both).
+  * Positive certification: a ``sequential`` witness linearizes each op
+    before its process's next op opens, hence before that op — the
+    witness respects program order, so a PASS certifies sequential
+    consistency. (The rung may be stricter than full SC: stream order
+    still carries the cross-process edges the interval encoding cannot
+    drop — exact SC checking is NP-hard and out of scope; this is the
+    tractable interval-order relaxation.) A ``session`` PASS certifies
+    that each read observes all earlier same-session ops — monotonic
+    reads + read-your-writes.
+
+Why the rungs are CHEAPER: a weaker rung admits more witnesses, so the
+one-pass greedy certifier below (O(events · window), pure host scan, no
+kernel launch) succeeds on the overwhelming majority of valid histories
+— the measured A/B win (scripts/ab_consistency.py). Rows greedy cannot
+certify fall through to the ordinary kernel ladder on the relaxed
+stream; greedy never *refutes*, so its answers are sound by
+construction (the committed order IS a witness).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
+from ..platform import env_int
+
+#: Rung names, strongest first. Index = position in the ladder.
+CONSISTENCY_LEVELS = ("linearizable", "sequential", "session")
+
+_ALIASES = {
+    "lin": "linearizable",
+    "linearizability": "linearizable",
+    "seq": "sequential",
+    "monotonic-reads": "session",
+    "monotonic": "session",
+}
+
+
+def normalize_consistency(name: Optional[str]) -> str:
+    """Canonical rung name (aliases accepted); ValueError on unknowns —
+    the service maps that to a 400 at admission, never into the queue."""
+    if name is None:
+        return "linearizable"
+    n = _ALIASES.get(str(name).strip().lower(), str(name).strip().lower())
+    if n not in CONSISTENCY_LEVELS:
+        raise ValueError(
+            f"unknown consistency {name!r}; valid: "
+            f"{CONSISTENCY_LEVELS} (aliases: {sorted(_ALIASES)})")
+    return n
+
+
+def rung_index(name: str) -> int:
+    return CONSISTENCY_LEVELS.index(normalize_consistency(name))
+
+
+def greedy_on() -> bool:
+    """Whether the greedy witness certifier runs before the kernel pass
+    on weaker rungs. ``JGRAFT_GREEDY_CERTIFY=0`` disables it — the
+    ablation arm (rung verdicts must be identical either way, pinned by
+    tests) and the A/B denominator."""
+    return env_int("JGRAFT_GREEDY_CERTIFY", 1, minimum=0) != 0
+
+
+# ----------------------------------------------------- stream relaxation
+
+
+def relax_encoded(enc: EncodedHistory, model,
+                  consistency: str) -> EncodedHistory:
+    """Re-encode one packed history with the rung's relaxed FORCE
+    placement (module docstring). Pure host transform on the packed
+    tensors; slot assignment is re-run so the relaxed stream is a
+    first-class `EncodedHistory` every kernel family accepts.
+
+    An encoding without per-event process ids (`proc is None` — hand
+    built, or loaded from an older artifact) cannot be relaxed
+    per-process; it is returned UNCHANGED, which is conservative and
+    sound in both directions (the rung is then exactly linearizability
+    for that row: a pass still implies the weaker guarantee, a fail
+    still certifies non-linearizability)."""
+    consistency = normalize_consistency(consistency)
+    if consistency == "linearizable" or enc.n_events == 0:
+        return enc
+    proc = enc.proc
+    if proc is None or len(proc) != enc.n_events:
+        return enc
+    events = enc.events
+    op_index = enc.op_index
+    readonly = frozenset(getattr(model, "readonly_fcodes", ()) or ())
+
+    # -- decode the stream back into ops -------------------------------
+    # op record: [open_pos, f, a, b, open_idx, pid, force_pos|-1,
+    #             force_idx] (force_idx = the completion row's history
+    #             index — FORCE rows must keep reporting it so rung
+    #             counterexamples point at the completion, like the
+    #             original encoding's op_index convention).
+    ops: List[list] = []
+    active: dict = {}          # slot -> op record index
+    per_proc: dict = {}        # pid -> [op record index...] in open order
+    for pos in range(enc.n_events):
+        et = int(events[pos, 0])
+        slot = int(events[pos, 1])
+        if et == EV_OPEN:
+            k = len(ops)
+            ops.append([pos, int(events[pos, 2]), int(events[pos, 3]),
+                        int(events[pos, 4]), int(op_index[pos]),
+                        int(proc[pos]), -1, -1])
+            active[slot] = k
+            per_proc.setdefault(int(proc[pos]), []).append(k)
+        elif et == EV_FORCE:
+            k = active.pop(slot)
+            ops[k][6] = pos
+            ops[k][7] = int(op_index[pos])
+
+    # -- per-process deferral targets ----------------------------------
+    END = enc.n_events
+    anchor: List[Optional[int]] = [None] * len(ops)  # forced ops only
+    for pid, ks in per_proc.items():
+        for j, k in enumerate(ks):
+            if ops[k][6] < 0:
+                continue  # optional op: never forced, nothing to move
+            later = ks[j + 1:]
+            if consistency == "sequential":
+                cand = ops[later[0]][0] if later else END
+            else:  # session: next same-process READ open
+                cand = END
+                for k2 in later:
+                    if ops[k2][1] in readonly:
+                        cand = ops[k2][0]
+                        break
+            # Monotone-relaxation clamp: never move a FORCE earlier
+            # than its real-time position (ill-formed inputs included).
+            anchor[k] = cand if cand > ops[k][6] else ops[k][6]
+
+    # -- rebuild: opens at their positions, forces just before their
+    # anchor opens (END = past everything); ties among deferred forces
+    # keep original completion order. kind 0 (force) sorts before kind 1
+    # (open) at the same anchor, which is exactly "just before".
+    items = []
+    for k, o in enumerate(ops):
+        items.append((o[0], 1, k, EV_OPEN))
+        if o[6] >= 0:
+            items.append((anchor[k], 0, o[6], EV_FORCE, k))
+    items.sort(key=lambda it: (it[0], it[1], it[2]))
+
+    n_ev = len(items)
+    out = np.zeros((n_ev, 5), dtype=np.int32)
+    out_idx = np.empty(n_ev, dtype=np.int32)
+    out_proc = np.empty(n_ev, dtype=np.int32)
+    slot_of: dict = {}
+    free: List[int] = []
+    next_slot = 0
+    for j, it in enumerate(items):
+        if it[3] == EV_OPEN:
+            k = it[2]
+            if free:
+                s = heapq.heappop(free)
+            else:
+                s = next_slot
+                next_slot += 1
+            slot_of[k] = s
+            out[j] = (EV_OPEN, s, ops[k][1], ops[k][2], ops[k][3])
+            out_idx[j] = ops[k][4]
+        else:
+            k = it[4]
+            s = slot_of[k]
+            out[j] = (EV_FORCE, s, 0, 0, 0)
+            heapq.heappush(free, s)
+            out_idx[j] = ops[k][7]
+        out_proc[j] = ops[k][5]
+    return EncodedHistory(events=out, op_index=out_idx,
+                          n_slots=next_slot, n_ops=len(ops),
+                          proc=out_proc)
+
+
+# ------------------------------------------------------ greedy certifier
+
+
+def greedy_certify(enc: EncodedHistory, model) -> bool:
+    """One-pass witness construction on an encoded stream. Two commit
+    rules build the order:
+
+      * EAGER observations: a pending READ-ONLY op (an opcode the model
+        declares in `readonly_fcodes` — never mutates at ANY state)
+        that is legal NOW commits immediately — provably lossless: if
+        any witness places a read-only op elsewhere, moving it to any
+        legal point yields another witness, so committing at the first
+        legal moment never forecloses anything. (The rule must key on
+        the opcode, not on "step preserved the state here": a write
+        that is a no-op at the CURRENT state can still be the mutation
+        a later read depends on.) This is what lets reads that
+        linearized early but completed late (the common shape under
+        concurrency) certify without search.
+      * LAZY mutations: a state-changing op commits only when its FORCE
+        demands it, or when a forced op needs its effect (older pending
+        ops are tried in open order).
+
+      * FORCED-FIRST retries: when a forced op needs older effects, the
+        retry pass offers ops that will themselves be forced (known
+        outcomes) before optional crashed ops — an always-legal
+        optional mutation (a crashed enqueue, an info add) committed
+        too eagerly poisons every later exact observation, so the
+        optionals are spent only when nothing forced helps.
+
+    Returns True iff a complete legal witness was built — the committed
+    order respects every op's [OPEN, FORCE] interval, so True is a
+    sound VALID for whatever rung produced the stream. False means
+    *undecided* (greedy took a wrong turn), never invalid; callers fall
+    through to the exact kernel ladder."""
+    state = model.init_state()
+    step = model.step
+    readonly = frozenset(getattr(model, "readonly_fcodes", ()) or ())
+    events = enc.events.tolist()
+    # Per-open forced-ness: does this open's slot see a FORCE before the
+    # slot is reused? (Packing recycles a slot only at its FORCE, so the
+    # next event on the slot answers directly.)
+    next_on_slot: dict = {}
+    forced_open = [False] * len(events)
+    for pos in range(len(events) - 1, -1, -1):
+        et, slot = events[pos][0], events[pos][1]
+        if et == EV_OPEN:
+            forced_open[pos] = next_on_slot.get(slot) == EV_FORCE
+        next_on_slot[slot] = et
+
+    # op record: [f, a, b, done, will_be_forced]
+    pending: List[list] = []
+    by_slot: dict = {}
+
+    def sweep():
+        # One pass suffices: read-only commits leave the state (the
+        # only legality input) unchanged.
+        for o in pending:
+            if not o[3] and o[0] in readonly and \
+                    step(state, o[0], o[1], o[2])[1]:
+                o[3] = True
+
+    for pos, row in enumerate(events):
+        et, slot = row[0], row[1]
+        if et == EV_OPEN:
+            f, a, b = row[2], row[3], row[4]
+            e = [f, a, b, False, forced_open[pos]]
+            by_slot[slot] = e
+            # Eager-commit at open when read-only and already legal
+            # (the rest of `pending` was swept at this same state).
+            if f in readonly and step(state, f, a, b)[1]:
+                e[3] = True
+            else:
+                pending.append(e)
+        elif et == EV_FORCE:
+            e = by_slot.pop(slot)
+            if e[3]:
+                continue
+            s2, legal = step(state, e[0], e[1], e[2])
+            if legal:
+                state = s2
+                e[3] = True
+                sweep()
+            else:
+                # Commit older pending ops (open order, forced tier
+                # first) whose step is legal, re-trying the forced op
+                # after each commit.
+                while not e[3]:
+                    progressed = False
+                    for tier in (True, False):
+                        for o in pending:
+                            if o is e or o[3] or o[4] is not tier:
+                                continue
+                            s2, legal = step(state, o[0], o[1], o[2])
+                            if not legal:
+                                continue
+                            state = s2
+                            o[3] = True
+                            progressed = True
+                            sweep()
+                            s3, l3 = step(state, e[0], e[1], e[2])
+                            if l3:
+                                state = s3
+                                e[3] = True
+                                sweep()
+                            break
+                        if progressed:
+                            break
+                    if not progressed:
+                        return False  # undecided — kernel decides
+            pending = [o for o in pending if not o[3]]
+    return True
+
+
+# ------------------------------------------------------------ batch entry
+
+
+def apply_rung(encs: Sequence[EncodedHistory], model, consistency: str):
+    """Certify/relax a batch at `consistency`. Returns (out, certified):
+    `certified[i]` True where a greedy witness already proves the row
+    VALID at the rung (then `out[i]` is whichever encoding certified
+    it); otherwise `out[i]` is the rung-relaxed encoding for the
+    ordinary kernel ladder.
+
+    Certification order exploits monotone relaxation: a witness for the
+    ORIGINAL (linearizable) stream is a witness for every weaker rung,
+    and the original stream's FORCE order — real completion order, an
+    approximation of the linearization order — is exactly the guidance
+    the greedy scan needs, so it succeeds there on most valid
+    histories and the row never pays the relaxation pass at all. Rows
+    it misses relax and retry (the relaxed stream admits rung-only
+    witnesses, e.g. stale reads); rows still undecided go to the
+    kernels on the relaxed stream."""
+    consistency = normalize_consistency(consistency)
+    n = len(encs)
+    out: list = list(encs)
+    certified = [False] * n
+    greedy = greedy_on()
+    for i, e in enumerate(encs):
+        if greedy and e.n_events > 0 and greedy_certify(e, model):
+            certified[i] = True
+            continue
+        out[i] = relax_encoded(e, model, consistency)
+        if greedy and out[i].n_events > 0 and \
+                greedy_certify(out[i], model):
+            certified[i] = True
+    return out, certified

@@ -1,13 +1,24 @@
-"""Multi-key history decomposition.
+"""Multi-key history decomposition — batched across keys.
 
 Equivalent of jepsen.independent/checker (reference register.clj:106):
 ops whose values are ``(key, value)`` tuples are split into per-key
 sub-histories, each checked independently.
 
-TPU-first twist: for linearizability this is not a loop over keys — the
-per-key sub-histories are exactly the batch dimension the frontier kernel
-vmaps over (SURVEY.md §2.4 row 2), so `IndependentLinearizable` packs all
-keys into ONE batched kernel launch.
+TPU-first twist (reworked for the scenario tier): per-key checking is
+not a loop over keys — the per-key sub-histories are exactly the batch
+dimension the frontier kernel vmaps over (SURVEY.md §2.4 row 2), and
+batch-axis rows never exchange state in any kernel family
+(doc/checker-design.md §8 — the same independence argument graftd's
+cross-request coalescing rests on). :func:`check_keyed` is the one home
+of that path: every key's sub-history is ENCODED EXACTLY ONCE
+(`history.packing.encode_history`), the encodings enter
+`checker.linearizable.check_encoded` as ONE cross-key batch (dense
+grouping, pow2+midpoint bucketing, macro compaction, the chunked
+wavefront and the weaker-consistency rungs all apply unchanged), and
+the per-key verdicts demux back by position — verdict-identical to K
+sequential checker invocations, measured K× fewer launches.
+`IndependentLinearizable` is the Checker-protocol face of it;
+`IndependentChecker` remains the generic (non-frontier) composition.
 """
 
 from __future__ import annotations
@@ -15,9 +26,10 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional
 
 from ..history.ops import History
+from ..history.packing import encode_history
 from ..models.base import Model
 from .base import Checker, merge_valid
-from .linearizable import check_histories
+from .linearizable import DEFAULT_MAX_CPU_CONFIGS, check_encoded
 
 
 def split_by_key(history: History) -> Dict:
@@ -38,8 +50,30 @@ def split_by_key(history: History) -> Dict:
     return subs
 
 
+def check_keyed(subs: Dict, model, algorithm: str = "auto",
+                n_configs: Optional[int] = None,
+                n_slots: Optional[int] = None,
+                max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
+                consistency: str = "linearizable") -> Dict:
+    """Check per-key sub-histories as ONE cross-key `check_encoded`
+    batch (module docstring). Returns {key: result dict} in the input's
+    key order. This is the multi-key seam both the live checker and the
+    service-tier admission ride: encode once, batch once, demux."""
+    keys = list(subs.keys())
+    if not keys:
+        return {}
+    encs = [encode_history(subs[k], model) for k in keys]
+    rs = check_encoded(encs, model, algorithm, n_configs, n_slots,
+                       max_cpu_configs=max_cpu_configs,
+                       consistency=consistency)
+    return dict(zip(keys, rs))
+
+
 class IndependentChecker(Checker):
-    """Generic per-key composition: run `checker_factory()` per key."""
+    """Generic per-key composition: run `checker_factory()` per key.
+    (Kept for NON-frontier checkers; frontier models take the batched
+    `IndependentLinearizable`/`check_keyed` path instead of K sequential
+    invocations.)"""
 
     def __init__(self, checker_factory: Callable[[], Checker]):
         self.checker_factory = checker_factory
@@ -60,23 +94,24 @@ class IndependentChecker(Checker):
 
 
 class IndependentLinearizable(Checker):
-    """Per-key linearizability as one batched TPU kernel launch."""
+    """Per-key linearizability (or a weaker rung) as one batched kernel
+    launch over the cross-key batch axis."""
 
     def __init__(self, model_factory: Callable[[], Model],
                  algorithm: str = "auto",
                  n_configs: Optional[int] = None,
                  n_slots: Optional[int] = None,
-                 max_cpu_configs: Optional[int] = None):
-        from .linearizable import DEFAULT_MAX_CPU_CONFIGS
-
+                 max_cpu_configs: Optional[int] = None,
+                 consistency: str = "linearizable"):
         self.model_factory = model_factory
         self.algorithm = algorithm
         self.n_configs = n_configs
         self.n_slots = n_slots
         self.max_cpu_configs = max_cpu_configs or DEFAULT_MAX_CPU_CONFIGS
+        self.consistency = consistency
 
     def check(self, test, history, opts=None) -> dict:
-        from .linearizable import INVALID
+        from .base import INVALID
         from .counterexample import (attach_counterexample,
                                      write_counterexample_html)
 
@@ -85,23 +120,22 @@ class IndependentLinearizable(Checker):
         subs = split_by_key(history.client_ops())
         if not subs:
             return {"valid?": True, "key-count": 0, "results": {}}
-        keys = list(subs.keys())
         model = self.model_factory()
-        rs = check_histories(
-            [subs[k] for k in keys], model, self.algorithm,
-            self.n_configs, self.n_slots,
-            max_cpu_configs=self.max_cpu_configs,
-        )
+        keyed = check_keyed(subs, model, self.algorithm, self.n_configs,
+                            self.n_slots,
+                            max_cpu_configs=self.max_cpu_configs,
+                            consistency=self.consistency)
         store_dir = (test or {}).get("store_dir")
-        for k, r in zip(keys, rs):
+        for k, r in keyed.items():
             if r.get("valid?") is INVALID:
                 attach_counterexample(r, subs[k], model,
-                                      max_cpu_configs=self.max_cpu_configs)
+                                      max_cpu_configs=self.max_cpu_configs,
+                                      consistency=self.consistency)
                 write_counterexample_html(r, subs[k], store_dir,
                                           f"counterexample-{k}.html")
-        results = {str(k): r for k, r in zip(keys, rs)}
+        results = {str(k): r for k, r in keyed.items()}
         return {
             "valid?": merge_valid(r.get("valid?") for r in results.values()),
-            "key-count": len(keys),
+            "key-count": len(keyed),
             "results": results,
         }

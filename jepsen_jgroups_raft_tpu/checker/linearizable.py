@@ -124,6 +124,7 @@ def check_histories(
     n_slots: Optional[int] = None,
     witness: bool = False,
     max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
+    consistency: str = "linearizable",
 ) -> list[dict]:
     """Check a batch of histories; returns one result dict per history.
 
@@ -133,10 +134,16 @@ def check_histories(
     the batch's real maximum (exact ≤16 slots, else bucketed to
     SLOT_BUCKETS 31/63/95/127) — per-event closure work scales with C×W,
     so a snug window is a direct kernel-speed win.
+
+    ``consistency`` selects the verdict's rung on the weaker-consistency
+    ladder (checker/consistency.py): "linearizable" (default),
+    "sequential", "session" — weaker rungs re-run the SAME machinery on
+    a relaxed-precedence re-encoding, with a greedy witness fast path.
     """
     encs = [encode_history(h, model) for h in histories]
     return check_encoded(encs, model, algorithm, n_configs, n_slots,
-                         witness, max_cpu_configs)
+                         witness, max_cpu_configs,
+                         consistency=consistency)
 
 
 def check_encoded(
@@ -148,6 +155,7 @@ def check_encoded(
     witness: bool = False,
     max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
     distribute: bool = True,
+    consistency: str = "linearizable",
 ) -> list[dict]:
     """Pack-once/check-many entry: verify histories that are ALREADY
     encoded (`history.packing.encode_history`), one result dict each.
@@ -172,8 +180,43 @@ def check_encoded(
     per-host scheduler, whose admission queues are host-local) and
     ``JGRAFT_DISTRIBUTED=0`` both pin the single-process path; outside
     a cluster the seam is inert by construction.
+
+    ``consistency`` (checker/consistency.py): a weaker rung relaxes the
+    batch's FORCE placement ONCE here, greedy-certifies what a host
+    witness scan can, and re-enters this entry at the linearizable rung
+    with the relaxed encodings — so every downstream path (dense
+    grouping, bucketing, chunked wavefront, distribution, graftd
+    coalescing) serves the rungs unchanged. Results carry a
+    ``consistency`` key whenever a non-default rung decided them.
     """
     from ..parallel import distributed
+
+    consistency = _normalize_rung(consistency)
+    if consistency != "linearizable":
+        from .consistency import apply_rung
+
+        relaxed, certified = apply_rung(encs, model, consistency)
+        results: list[Optional[dict]] = [None] * len(encs)
+        todo: list[int] = []
+        for i, (enc, ok) in enumerate(zip(relaxed, certified)):
+            if ok:
+                results[i] = {
+                    "valid?": VALID, "algorithm": "greedy-witness",
+                    "op-count": enc.n_ops,
+                    "concurrency-window": enc.n_slots,
+                }
+            else:
+                todo.append(i)
+        if todo:
+            sub = check_encoded([relaxed[i] for i in todo], model,
+                                algorithm, n_configs, n_slots, witness,
+                                max_cpu_configs, distribute,
+                                consistency="linearizable")
+            for i, r in zip(todo, sub):
+                results[i] = r
+        for r in results:
+            r["consistency"] = consistency
+        return results  # type: ignore[return-value]
 
     if distribute and distributed.wavefront_active() and len(encs) > 1:
         results = distributed.run_sharded(
@@ -192,6 +235,16 @@ def check_encoded(
         for r in results:
             r.setdefault("platform-degraded", note)
     return results
+
+
+def _normalize_rung(name) -> str:
+    """Cheap-path normalization: the default rung never imports the
+    consistency module (keeps the hot linearizable path import-free)."""
+    if name in (None, "linearizable"):
+        return "linearizable"
+    from .consistency import normalize_consistency
+
+    return normalize_consistency(name)
 
 
 def _check_encoded(
@@ -719,19 +772,34 @@ def _jx(valid, enc: EncodedHistory, secs: float,
 
 def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
                        max_cpu_configs: Optional[int]
-                       = DEFAULT_MAX_CPU_CONFIGS) -> dict:
+                       = DEFAULT_MAX_CPU_CONFIGS,
+                       consistency: str = "linearizable") -> dict:
     """Host-only verdict ladder for one encoded history: the capped CPU
     frontier first, the budgeted DFS when the frontier reports UNKNOWN —
     never a device launch. This is graftd's degrade path (the service
     re-checks a batch through it when the device pass raises mid-check),
-    mirroring `auto` mode's escalation order without re-entering jax."""
+    mirroring `auto` mode's escalation order without re-entering jax.
+    A weaker ``consistency`` rung relaxes/greedy-certifies exactly like
+    `check_encoded`, so degraded rung verdicts match the device path."""
     if enc.n_events == 0:
         return {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
+    consistency = _normalize_rung(consistency)
+    if consistency != "linearizable":
+        from .consistency import apply_rung
+
+        [enc], [certified] = apply_rung([enc], model, consistency)
+        if certified:
+            return {"valid?": VALID, "algorithm": "greedy-witness",
+                    "op-count": enc.n_ops,
+                    "concurrency-window": enc.n_slots,
+                    "consistency": consistency}
     r = _check_cpu(enc, model, witness, max_cpu_configs)
     if r.get("valid?") is UNKNOWN:
         r2 = _check_dfs(enc, model, witness, max_steps=DEFAULT_DFS_BUDGET)
         if r2["valid?"] is not UNKNOWN:
-            return r2
+            r = r2
+    if consistency != "linearizable":
+        r["consistency"] = consistency
     return r
 
 
@@ -758,17 +826,20 @@ def _check_cpu(enc: EncodedHistory, model, witness: bool,
 
 
 class LinearizableChecker(Checker):
-    """Checker-protocol wrapper around `check_histories` for one history."""
+    """Checker-protocol wrapper around `check_histories` for one history.
+    ``consistency`` selects the ladder rung (checker/consistency.py)."""
 
     def __init__(self, model, algorithm: str = "auto",
                  n_configs: Optional[int] = None,
                  n_slots: Optional[int] = None,
-                 max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS):
+                 max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
+                 consistency: str = "linearizable"):
         self.model = model
         self.algorithm = algorithm
         self.n_configs = n_configs
         self.n_slots = n_slots
         self.max_cpu_configs = max_cpu_configs
+        self.consistency = _normalize_rung(consistency)
 
     def check(self, test, history, opts=None) -> dict:
         from .counterexample import (attach_counterexample,
@@ -783,10 +854,12 @@ class LinearizableChecker(Checker):
         [result] = check_histories(
             [hist], self.model, self.algorithm, self.n_configs, self.n_slots,
             witness=True, max_cpu_configs=self.max_cpu_configs,
+            consistency=self.consistency,
         )
         if result.get("valid?") is INVALID:
             attach_counterexample(result, hist, self.model,
-                                  max_cpu_configs=self.max_cpu_configs)
+                                  max_cpu_configs=self.max_cpu_configs,
+                                  consistency=self.consistency)
             write_counterexample_html(result, hist,
                                       (test or {}).get("store_dir"),
                                       "counterexample.html")

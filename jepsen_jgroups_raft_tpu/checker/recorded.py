@@ -23,7 +23,7 @@ import time
 from pathlib import Path
 from typing import Optional, Sequence
 
-from ..models import CasRegister, Counter
+from ..models import CasRegister, Counter, GSet, TicketQueue
 from ..models.base import Model
 from ..models.leader import MajorityLeaderModel
 from .base import INVALID, UNKNOWN, VALID, merge_valid
@@ -41,6 +41,8 @@ WORKLOAD_MODELS = {
     "multi-register": (CasRegister, True),
     "counter": (Counter, False),
     "election": (MajorityLeaderModel, False),
+    "set": (GSet, False),
+    "queue": (TicketQueue, False),
 }
 
 

@@ -23,15 +23,20 @@ from pathlib import Path
 
 from .core.compose import DEFAULTS, compose_test
 from .core.runner import run_test
-from .nemesis.package import FAULTS, SPECIALS
+from .nemesis.package import FAULTS, SCHEDULES, SPECIALS
 from .workload import WORKLOADS
 
 # workload → native state machine (identify-state-machine, server.clj:103-109)
+# The scenario tier's set/queue live in one register of the replicated
+# map (CAS retry loops — workload/set.py, workload/queue.py), so they
+# ride the "map" SM on every deployment tier.
 WORKLOAD_SM = {
     "single-register": "map",
     "multi-register": "map",
     "counter": "counter",
     "election": "election",
+    "set": "map",
+    "queue": "map",
 }
 
 
@@ -40,9 +45,12 @@ def _add_test_flags(p: argparse.ArgumentParser) -> None:
                    choices=sorted(WORKLOADS),
                    help="workload name (raft.clj:29-33)")
     p.add_argument("--nemesis", default=None,
-                   help="comma-separated faults %s or special %s "
-                        "(raft.clj:35-39, nemesis.clj:8-29)"
-                        % (sorted(FAULTS), sorted(SPECIALS)))
+                   help="comma-separated faults %s, workload-paired "
+                        "schedules %s, or special %s "
+                        "(raft.clj:35-39, nemesis.clj:8-29); set/queue "
+                        "default to their paired schedule when omitted"
+                        % (sorted(FAULTS), sorted(SCHEDULES),
+                           sorted(SPECIALS)))
     p.add_argument("--rate", type=float, default=DEFAULTS["rate"],
                    help="approximate ops/sec (raft.clj:19-22)")
     p.add_argument("--ops-per-key", type=int, default=DEFAULTS["ops_per_key"],
@@ -80,6 +88,13 @@ def _add_test_flags(p: argparse.ArgumentParser) -> None:
                    help="linearizability engine (:algorithm :jax analogue; "
                         "race = kernel vs DFS, first finisher wins, the "
                         "knossos.competition analogue)")
+    p.add_argument("--consistency", default="linearizable",
+                   choices=["linearizable", "sequential", "session"],
+                   help="consistency ladder rung for the workload's "
+                        "frontier checker (checker/consistency.py): "
+                        "weaker rungs drop real-time edges, keep "
+                        "per-process order, and decide measurably "
+                        "cheaper")
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu"],
                    help="pin the JAX backend for checking (e.g. cpu when "
@@ -166,6 +181,7 @@ def cmd_test(args) -> int:
             "conn_factory": conn_factory,
             "store_root": args.store,
             "algorithm": args.algorithm,
+            "consistency": args.consistency,
         }
         if args.workload == "election":
             # Default-on majority model: wired whenever the deployment

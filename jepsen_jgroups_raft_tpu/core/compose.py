@@ -72,6 +72,12 @@ def compose_test(opts: dict, db=None, net=None,
 
     db = db if db is not None else o.get("db")
     net = net if net is not None else o.get("net")
+    if o.get("nemesis") is None and wl.get("suggested_nemesis"):
+        # Paired fault schedule (ISSUE 10 satellite): a workload may name
+        # the schedule that actually stresses it (set → membership churn
+        # during the fill, queue → partition during the drain); an
+        # explicit --nemesis (including "none") always wins.
+        o["nemesis"] = wl["suggested_nemesis"]
     pkg = setup_nemesis(o, db, net, seed=seed)
 
     client_gen = Stagger(1.0 / float(o["rate"]), wl["generator"])

@@ -73,12 +73,18 @@ class EncodedHistory:
                      event (-1 for padding) — for counterexample reporting.
     n_slots:  width of the concurrency window actually used.
     n_ops:    number of encoded (non-dropped) ops.
+    proc:     [E]    int32 dense process id of the op behind each event,
+                     or None (hand-built encodings). Kernels never read
+                     it — it exists for the weaker-consistency rung
+                     relaxation (checker/consistency.py), which defers
+                     FORCE events along per-process program order.
     """
 
     events: np.ndarray
     op_index: np.ndarray
     n_slots: int
     n_ops: int
+    proc: Optional[np.ndarray] = None
 
     @property
     def n_events(self) -> int:
@@ -138,6 +144,8 @@ def encode_history(
 
     rows: List[tuple] = []
     op_idx: List[int] = []
+    procs: List[int] = []
+    pid_of: dict = {}
     free: List[int] = []  # min-heap of recyclable slots
     next_slot = 0
     slot_of: dict = {}  # invoke position -> slot
@@ -152,10 +160,13 @@ def encode_history(
             slot_of[i] = slot
             rows.append((EV_OPEN, slot, enc.f, enc.a, enc.b))
             op_idx.append(op.index if op.index >= 0 else i)
+            procs.append(pid_of.setdefault(op.process, len(pid_of)))
         elif i in forces:
             slot = slot_of[forces[i]]
             rows.append((EV_FORCE, slot, 0, 0, 0))
             op_idx.append(op.index if op.index >= 0 else i)
+            procs.append(pid_of.setdefault(ops[forces[i]].process,
+                                           len(pid_of)))
             heapq.heappush(free, slot)
 
     events = np.asarray(rows, dtype=np.int32).reshape(-1, 5)
@@ -164,6 +175,7 @@ def encode_history(
         op_index=np.asarray(op_idx, dtype=np.int32),
         n_slots=next_slot,
         n_ops=len(opens),
+        proc=np.asarray(procs, dtype=np.int32),
     )
 
 
@@ -242,8 +254,14 @@ def _encode_history_columnar(ops, model, cols, prune: bool) -> EncodedHistory:
     op_idx = np.fromiter(
         ((ops[p].index if ops[p].index >= 0 else p) for p in pos_l),
         dtype=np.int32, count=n_ev)
+    # Per-event dense process ids (a FORCE's completion op shares its
+    # invoke's process, so indexing by history position is uniform).
+    pid_of: dict = {}
+    proc = np.fromiter(
+        (pid_of.setdefault(ops[p].process, len(pid_of)) for p in pos_l),
+        dtype=np.int32, count=n_ev)
     return EncodedHistory(events=events, op_index=op_idx,
-                          n_slots=next_slot, n_ops=n)
+                          n_slots=next_slot, n_ops=n, proc=proc)
 
 
 def _prune_dead_crashed_columnar(model, fs, as_, bs, forced, ips, cps):

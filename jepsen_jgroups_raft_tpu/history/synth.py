@@ -40,9 +40,12 @@ def random_valid_history(
 ) -> History:
     """Generate a linearizable-by-construction history of n_ops ops.
 
-    model_kind: "register" (read/write/cas) or "counter"
-    (read/add/add-and-get). crash_p biases how often a pending op crashes
-    instead of completing (info ops are the checker-pressure knob).
+    model_kind: "register" (read/write/cas), "counter"
+    (read/add/add-and-get), "set" (add/read over the 32-wide
+    membership), or "queue" (ticket-FIFO enqueue/dequeue, completed
+    enqueues observing their assigned ticket). crash_p biases how often
+    a pending op crashes instead of completing (info ops are the
+    checker-pressure knob).
 
     A crashed process is REPLACED by a fresh process id, the way jepsen's
     runner remaps crashed worker ids — so the history really reaches n_ops
@@ -59,7 +62,12 @@ def random_valid_history(
 
     if max_crashes is None:
         max_crashes = n_procs
-    state = None if model_kind == "register" else 0
+    if model_kind == "register":
+        state = None
+    elif model_kind == "queue":
+        state = (0, 0)  # (head, tail)
+    else:
+        state = 0  # counter value / set membership mask
     rows = []
     # pending: process -> dict(f, value, linearized?, result)
     pending: dict = {}
@@ -93,6 +101,12 @@ def random_valid_history(
                     value = rng.randrange(value_range)
                 else:
                     value = (rng.randrange(value_range), rng.randrange(value_range))
+            elif model_kind == "set":
+                f = rng.choice(["add", "add", "read"])
+                value = rng.randrange(value_range) if f == "add" else None
+            elif model_kind == "queue":
+                f = rng.choice(["enqueue", "enqueue", "dequeue"])
+                value = None
             else:
                 f = rng.choice(["read", "add", "add-and-get"])
                 value = None if f == "read" else rng.randrange(1, value_range + 1)
@@ -116,6 +130,23 @@ def random_valid_history(
                         d["result"] = True
                     else:
                         d["result"] = False
+            elif model_kind == "set":
+                if f == "add":
+                    state |= 1 << v
+                    d["result"] = None
+                else:
+                    d["result"] = [i for i in range(32)
+                                   if (state >> i) & 1]
+            elif model_kind == "queue":
+                h, t = state
+                if f == "enqueue":
+                    state = (h, t + 1)
+                    d["result"] = t  # the assigned ticket
+                elif h == t:
+                    d["result"] = None  # empty observation
+                else:
+                    state = (h + 1, t)
+                    d["result"] = h
             else:
                 if f == "read":
                     d["result"] = state
@@ -134,8 +165,8 @@ def random_valid_history(
                 rows.append((p, FAIL, f, d["value"]))
             elif f == "read":
                 rows.append((p, OK, f, r))
-            elif f == "add-and-get":
-                rows.append((p, OK, f, r))
+            elif f in ("add-and-get", "enqueue", "dequeue"):
+                rows.append((p, OK, f, r))  # observed ticket / new value
             else:
                 rows.append((p, OK, f, d["value"]))
             free.append(p)
@@ -164,10 +195,16 @@ def corrupt(rng: random.Random, hist: History) -> History:
         return hist
     i = rng.choice(idxs)
     p, t, f, v = rows[i]
-    if f in ("read",):
+    if f == "read" and isinstance(v, list):
+        # set membership read: drop an observed element or claim one
+        v = v[1:] if v else [rng.randrange(4)]
+    elif f in ("read",):
         v = (v if isinstance(v, int) and v is not None else 0) + rng.choice([1, -1])
     elif f == "add-and-get" and v is not None:
         v = (v[0], v[1] + rng.choice([1, -1]))
+    elif f in ("enqueue", "dequeue"):
+        # perturb the observed ticket (an empty dequeue claims one)
+        v = (v + 1) if isinstance(v, int) else 0
     elif f == "write":
         pass  # write completions carry the written value; leave
     rows[i] = (p, t, f, v)

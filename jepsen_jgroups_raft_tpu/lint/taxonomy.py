@@ -68,10 +68,19 @@ LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
 #: scripts/chaos_graftd.py rides along (ISSUE 8): a chaos harness that
 #: silently swallows an exception reports invariants it never checked —
 #: its handlers must be narrow or visible like the daemon's own.
-SCAN_PREFIXES = ("client/", "workload/", "deploy/", "service/")
+#: The scenario tier (ISSUE 10) widens the net: generator/ rides along
+#: (the set/queue workloads put stateful op generation there — a
+#: swallowed error in a generator silently starves a phase), and the
+#: scenario checkers (derived analyses + the consistency rung family)
+#: are scanned like the service tier — a broad except around a verdict
+#: path is exactly where an indefinite error could turn into a wrong
+#: "valid".
+SCAN_PREFIXES = ("client/", "workload/", "deploy/", "service/",
+                 "generator/")
 SCAN_FILES = ("core/runner.py", "native/client.py", "core/serve.py",
               "parallel/distributed.py", "parallel/launch.py",
-              "scripts/chaos_graftd.py")
+              "scripts/chaos_graftd.py", "checker/set_queue.py",
+              "checker/consistency.py", "checker/counterexample.py")
 
 
 def applies_to(relpath: str) -> bool:

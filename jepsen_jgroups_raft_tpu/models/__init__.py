@@ -13,10 +13,14 @@ from .base import Model, NIL  # noqa: F401
 from .register import CasRegister  # noqa: F401
 from .counter import Counter  # noqa: F401
 from .leader import LeaderModel  # noqa: F401
+from .setmodel import GSet  # noqa: F401
+from .queuemodel import TicketQueue  # noqa: F401
 
 #: name → constructor, used by workloads and the CLI.
 MODELS = {
     "cas-register": CasRegister,
     "counter": Counter,
     "leader": LeaderModel,
+    "set": GSet,
+    "queue": TicketQueue,
 }

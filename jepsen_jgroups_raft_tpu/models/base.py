@@ -128,6 +128,24 @@ class Model:
     #: derived from `mask_delta` subset sums (ops/dense_scan.py mask mode).
     mask_determined = False
 
+    #: Opcodes whose step never mutates state (pure observations). The
+    #: weaker-consistency rung family (checker/consistency.py) uses this
+    #: to place session-rung precedence edges: an op only has to
+    #: linearize before the same process's next *read*. Empty = the
+    #: session rung degrades to end-of-stream forces for that model.
+    readonly_fcodes: tuple = ()
+
+    def mask_eligible(self, events) -> bool:
+        """Per-HISTORY mask-mode eligibility (consulted by the dense
+        router alongside the class-level `mask_determined`). The mask
+        kernel derives per-config states as initial + subset SUMS of
+        `mask_delta`; a model whose state combine is order-independent
+        but not additive in general (e.g. a set: OR of element bits)
+        can still ride the mask kernel for the histories where sum and
+        combine coincide — this hook is that proof, checked against the
+        packed events. Default: the class-level claim."""
+        return self.mask_determined
+
     def mask_delta(self, f, a, b):
         """Vectorized: the state delta op (f, a, b) contributes when
         linearized (0 for pure reads). Only consulted when

@@ -32,6 +32,7 @@ ADD_AND_GET = 2
 class Counter(Model):
     name = "counter"
     n_fcodes = 3
+    readonly_fcodes = (READ,)
 
     def __init__(self, initial: int = 0):
         self.initial = _i32(initial)

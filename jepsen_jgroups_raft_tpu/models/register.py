@@ -32,6 +32,7 @@ F_NAMES = {"read": READ, "write": WRITE, "cas": CAS}
 class CasRegister(Model):
     name = "cas-register"
     n_fcodes = 3
+    readonly_fcodes = (READ,)
 
     def __init__(self, initial: Optional[int] = None):
         self.initial = NIL if initial is None else _i32(initial)

@@ -1,0 +1,195 @@
+"""Grow-only set model over a 32-wide membership bitmask.
+
+The scenario-tier twin of Jepsen's bread-and-butter set workload: clients
+``add`` small integer elements and ``read`` the full membership; the
+checker asks whether some linearization of the adds explains every
+observed membership. State is one int32 — bit ``e`` set ⇔ element ``e``
+is a member — so the model rides the branch-free kernel substrate
+unchanged (models/base.py int32 constraint).
+
+Op encoding (``f``, ``a``, ``b``):
+  * ``ADD e``      — state' = state | (1 << e); always legal.
+  * ``READ mask``  — legal iff state == mask (an exact membership
+                     observation: the reference set workloads read the
+                     whole set, so a read pins every bit, which is what
+                     makes stale/phantom elements *linearizability*
+                     violations here, not just derived-analysis ones).
+
+Completion semantics follow the taxonomy (models/base.py): ``fail`` adds
+are dropped, ``info`` adds are optional forever (they may have applied),
+``info`` reads constrain nothing and are dropped.
+
+Kernel routing: a grow-only set's combine (OR) is order-independent but
+NOT additive, so the class-level ``mask_determined`` stays False; the
+per-history ``mask_eligible`` hook proves the additive special case —
+every add in the history targets a distinct element absent from the
+initial mask — under which subset SUMS of single-bit deltas equal the
+OR, and the history rides the cheap mask kernel. Histories that re-add
+elements fall back to the domain kernel (small distinct-add counts) or
+the sort ladder, both exact.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..history.ops import FAIL, INFO, OK, OpPair
+from .base import EncodedOp, Model, _i32
+
+ADD = 0
+READ = 1
+
+#: Membership width: elements live in [0, 32) so the mask fits int32.
+SET_WIDTH = 32
+
+
+def element_mask(value) -> int:
+    """Element collection (or pre-packed int mask) → int32 bitmask."""
+    if value is None:
+        return 0
+    if isinstance(value, int):
+        if value >> SET_WIDTH:
+            raise ValueError(f"set mask {value:#x} exceeds {SET_WIDTH} bits")
+        return _i32(value & 0xFFFFFFFF)
+    mask = 0
+    for e in value:
+        e = int(e)
+        if not 0 <= e < SET_WIDTH:
+            raise ValueError(f"set element {e} outside [0, {SET_WIDTH})")
+        mask |= 1 << e
+    return _i32(mask)
+
+
+class GSet(Model):
+    name = "set"
+    n_fcodes = 2
+    readonly_fcodes = (READ,)
+
+    def __init__(self, initial: int = 0):
+        self.initial = element_mask(initial)
+
+    def init_state(self) -> int:
+        return self.initial
+
+    def step(self, state, f, a, b):
+        if f == ADD:
+            return _or32(state, a), True
+        if f == READ:
+            return state, state == a
+        raise ValueError(f"bad opcode {f}")
+
+    def jax_step(self, state, f, a, b):
+        is_add = f == ADD
+        legal = is_add | (state == a)
+        new_state = jnp.where(is_add, state | a, state)
+        return new_state, legal
+
+    def mask_delta(self, f, a, b):
+        # Valid ONLY under mask_eligible's distinct-bit proof: each
+        # add's single-bit delta sums without carries, so Σ == OR.
+        return jnp.where(f == ADD, a, 0)
+
+    def mask_eligible(self, events) -> bool:
+        """Additive special case: every ADD in the history carries a
+        distinct element bit not present in the initial mask (then
+        subset sums of the deltas equal the OR the step computes, with
+        no carries). READ deltas are 0, so only ADDs matter."""
+        import numpy as np
+
+        from ..history.packing import EV_OPEN
+
+        ev = np.asarray(events)
+        opens = ev[(ev[:, 0] == EV_OPEN) & (ev[:, 2] == ADD)]
+        adds = opens[:, 3].astype(np.int64) & 0xFFFFFFFF
+        if adds.size == 0:
+            return True
+        combined = np.bitwise_or.reduce(adds)
+        if combined & (np.int64(self.initial) & 0xFFFFFFFF):
+            return False
+        # distinct single bits ⇔ popcount(OR) == count and each is 1-bit
+        one_bit = np.all(adds & (adds - 1) == 0) and np.all(adds != 0)
+        return bool(one_bit and
+                    int(combined).bit_count() == int(adds.size))
+
+    def dense_domain(self, events) -> Optional[list]:
+        """Reachable states = initial ∪ {initial | OR(S)} over subsets S
+        of the distinct add masks — enumerable when few distinct adds
+        occur (e.g. short sub-histories); None hands bigger histories to
+        the mask kernel / sort ladder."""
+        import numpy as np
+
+        from ..history.packing import EV_OPEN
+
+        ev = np.asarray(events)
+        opens = ev[(ev[:, 0] == EV_OPEN) & (ev[:, 2] == ADD)]
+        distinct = sorted({int(a) & 0xFFFFFFFF for a in opens[:, 3]})
+        if len(distinct) > 4:  # 2^k states; DENSE_MAX_STATES is 16
+            return None
+        base = int(self.initial) & 0xFFFFFFFF
+        states = {base}
+        for m in distinct:
+            states |= {s | m for s in states}
+        return [_u2i(base)] + sorted(_u2i(s) for s in states - {base})
+
+    def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
+        f = pair.f
+        forced = pair.ctype == OK
+        if f == "add":
+            elem = pair.invoke.value
+            elem = int(elem)
+            if not 0 <= elem < SET_WIDTH:
+                raise ValueError(
+                    f"set: element {elem} outside [0, {SET_WIDTH})")
+            return EncodedOp(ADD, _i32(1 << elem), 0, forced)
+        if f == "read":
+            if not forced:
+                return None  # unknown read constrains nothing
+            return EncodedOp(READ, element_mask(pair.completion.value),
+                             0, True)
+        raise ValueError(f"set: unknown op f={f!r}")
+
+    def encode_pairs_columnar(self, pairs):
+        """Tight-loop twin of `_encode` (see Model.encode_pairs_columnar;
+        differential tests pin the two byte-identical). No prune hooks:
+        an add's enable set depends on the current state (OR), so the
+        conservative None default stands on both paths."""
+        fs, as_, bs = [], [], []
+        forced, ips, cps = [], [], []
+        for ip, cp, inv, comp in pairs:
+            ctype = comp.type if comp is not None else INFO
+            if ctype == FAIL:
+                continue
+            fo = ctype == OK
+            f = inv.f
+            if f == "add":
+                elem = int(inv.value)
+                if not 0 <= elem < SET_WIDTH:
+                    raise ValueError(
+                        f"set: element {elem} outside [0, {SET_WIDTH})")
+                fs.append(ADD)
+                as_.append(_i32(1 << elem))
+                bs.append(0)
+            elif f == "read":
+                if not fo:
+                    continue
+                fs.append(READ)
+                as_.append(element_mask(comp.value))
+                bs.append(0)
+            else:
+                raise ValueError(f"set: unknown op f={f!r}")
+            forced.append(fo)
+            ips.append(ip)
+            cps.append(cp)
+        return fs, as_, bs, forced, ips, cps
+
+
+def _or32(state: int, mask: int) -> int:
+    """int32 OR matching jnp.int32 semantics (negative masks = high bit)."""
+    v = (state & 0xFFFFFFFF) | (mask & 0xFFFFFFFF)
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def _u2i(v: int) -> int:
+    return v - (1 << 32) if v >= (1 << 31) else v

@@ -18,7 +18,8 @@ import random
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
-from ..generator.base import Delay, FlipFlop, Generator, Mix, OpFn, Seq
+from ..generator.base import (Delay, FlipFlop, Generator, Mix, OpFn, Seq,
+                              Sleep)
 from .base import Nemesis, NoopNemesis, compose_nemeses
 from .faults import KillNemesis, PartitionNemesis, PauseNemesis
 from .membership import GrowUntilFull, MemberNemesis
@@ -32,6 +33,13 @@ SPECIALS = {
     # *current* membership can be dead) — same caveat as nemesis.clj:18-22.
     "hell": ("pause", "kill", "partition", "member"),
 }
+
+#: Workload-paired schedules (ISSUE 10 satellite): named fault schedules
+#: tuned to what actually stresses a scenario, selectable like faults
+#: (--nemesis set-churn) and auto-suggested by their workloads
+#: (core/compose.py). Each rides the existing nemeses — so the PR-2
+#: fault↔heal pairing analyzer's guarantees carry over unchanged.
+SCHEDULES = ("set-churn", "queue-drain")
 
 
 def parse_nemesis_spec(spec) -> tuple:
@@ -49,9 +57,10 @@ def parse_nemesis_spec(spec) -> tuple:
     for f in faults:
         if f in SPECIALS and len(faults) == 1:
             return SPECIALS[f]
-        if f not in FAULTS:
+        if f not in FAULTS and f not in SCHEDULES:
             raise ValueError(
-                f"unknown fault {f!r}; valid: {FAULTS} or {tuple(SPECIALS)}")
+                f"unknown fault {f!r}; valid: {FAULTS}, schedules "
+                f"{SCHEDULES}, or {tuple(SPECIALS)}")
     return faults
 
 
@@ -135,6 +144,50 @@ def member_package(opts: dict, db, rng: random.Random) -> Package:
     )
 
 
+def set_churn_package(opts: dict, db, rng: random.Random) -> Package:
+    """Membership churn paired with the set workload's fill (ISSUE 10):
+    shrink/grow at TWICE the configured fault rate, so acknowledged adds
+    race view changes — the schedule that loses elements on a SUT whose
+    snapshot/catch-up path is buggy. Same MemberNemesis + GrowUntilFull
+    healing discipline as the stock member package (the fault↔heal
+    pairing analyzer's coverage carries over unchanged)."""
+    interval = max(0.5, float(opts.get("interval", 5.0)) / 2.0)
+    gen = Delay(interval, FlipFlop(
+        OpFn(lambda test, ctx: {"f": "shrink"}),
+        OpFn(lambda test, ctx: {"f": "grow"})))
+    return Package(
+        nemesis=MemberNemesis(db, seed=rng.randrange(2**31)),
+        generator=gen,
+        final_generator=Delay(0.25, GrowUntilFull()),
+        perf=[{"name": "set-churn", "start": {"shrink"}, "stop": {"grow"},
+               "color": "#3C8031"}],
+    )
+
+
+def queue_drain_package(opts: dict, db, net,
+                        rng: random.Random) -> Package:
+    """Partition paired with the queue workload's drain (ISSUE 10): the
+    fill phase runs clean long enough to build a backlog (first fault
+    delayed a full interval past the standard nemesis warm-up), then
+    majority-flavored partitions flip during the drain — the schedule
+    that double-delivers or loses tickets on a SUT whose leader handoff
+    re-serves a popped head. Same PartitionNemesis + stop-partition
+    healing as the stock package."""
+    interval = float(opts.get("interval", 5.0))
+    drain_targets = ("majority", "majorities-ring", "primaries")
+    gen = Seq([Sleep(interval),
+               Delay(interval, FlipFlop(
+                   _targeted("start-partition", drain_targets, rng),
+                   OpFn(lambda test, ctx: {"f": "stop-partition"})))])
+    return Package(
+        nemesis=PartitionNemesis(net, db, seed=rng.randrange(2**31)),
+        generator=gen,
+        final_generator=Seq([{"f": "stop-partition"}]),
+        perf=[{"name": "queue-drain", "start": {"start-partition"},
+               "stop": {"stop-partition"}, "color": "#E9A447"}],
+    )
+
+
 def compose_packages(packages: Sequence[Package],
                      seed: Optional[int] = None) -> Package:
     pkgs = [p for p in packages if p is not None]
@@ -167,4 +220,10 @@ def setup_nemesis(opts: dict, db, net=None,
             pkgs.append(pause_package(opts, db, rng))
         elif f == "member":
             pkgs.append(member_package(opts, db, rng))
+        elif f == "set-churn":
+            pkgs.append(set_churn_package(opts, db, rng))
+        elif f == "queue-drain":
+            if net is None:
+                raise ValueError("queue-drain schedule requires a Net")
+            pkgs.append(queue_drain_package(opts, db, net, rng))
     return compose_packages(pkgs, seed=rng.randrange(2**31))

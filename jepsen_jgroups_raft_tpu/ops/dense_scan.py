@@ -118,7 +118,8 @@ def dense_plan(model, encs: Sequence[EncodedHistory]) -> Optional[DensePlan]:
             # is exponential.
             S_b, val_of = _pad_domains(domains, range(len(domains)))
             return DensePlan("domain", max(W, 1), S_b, val_of)
-    if model.mask_determined and W <= MASK_DENSE_MAX_SLOTS:
+    if W <= MASK_DENSE_MAX_SLOTS and \
+            all(model.mask_eligible(e.events) for e in encs):
         dummy = np.zeros((len(encs), 1), dtype=np.int32)
         return DensePlan("mask", max(W, 1), 1, dummy)
     return None
@@ -224,7 +225,7 @@ def dense_plans_grouped(model, encs: Sequence[EncodedHistory]):
                 len(d) <= DENSE_MAX_STATES and \
                 (1 << W) * len(d) <= DENSE_MAX_CELLS:
             buckets.setdefault(("domain", W), []).append(i)
-        elif model.mask_determined and W <= MASK_DENSE_MAX_SLOTS:
+        elif W <= MASK_DENSE_MAX_SLOTS and model.mask_eligible(e.events):
             buckets.setdefault(("mask", W), []).append(i)
         else:
             rest.append(i)

@@ -134,25 +134,31 @@ class ServiceClient:
 
     def submit(self, histories: Sequence, workload: str = "register",
                algorithm: str = "auto", deadline_ms: Optional[float] = None,
-               priority: int = 0, retry: bool = True) -> dict:
+               priority: int = 0, retry: bool = True,
+               consistency: str = "linearizable") -> dict:
         """Submit histories (History objects or op-dict lists); returns
         the daemon's request record ({"id", "status", ...}). Retries
         429/503/connection failures with capped jittered backoff up to
         `max_attempts` (safe: submission is idempotent); the final
         failure raises ServiceError (read `.retry_after_s`) or the
-        connection error. `retry=False` fails fast."""
+        connection error. `retry=False` fails fast. `consistency`
+        selects the verdict's ladder rung (linearizable / sequential /
+        session)."""
         rows = [h.to_dicts() if hasattr(h, "to_dicts") else list(h)
                 for h in histories]
         return self._call("POST", "/submit", {
             "workload": workload, "histories": rows,
             "algorithm": algorithm, "deadline_ms": deadline_ms,
-            "priority": priority}, retry=retry)
+            "priority": priority, "consistency": consistency},
+            retry=retry)
 
     def submit_run_dir(self, run_dir: str, workload: Optional[str] = None,
-                       algorithm: str = "auto", retry: bool = True) -> dict:
+                       algorithm: str = "auto", retry: bool = True,
+                       consistency: str = "linearizable") -> dict:
         return self._call("POST", "/submit", {
             "run_dir": str(run_dir), "workload": workload,
-            "algorithm": algorithm}, retry=retry)
+            "algorithm": algorithm, "consistency": consistency},
+            retry=retry)
 
     def result(self, request_id: str,
                wait_s: Optional[float] = None) -> dict:
@@ -172,12 +178,14 @@ class ServiceClient:
 
     def check(self, histories: Sequence, workload: str = "register",
               algorithm: str = "auto", timeout_s: float = 300.0,
-              poll_s: float = 0.05) -> dict:
+              poll_s: float = 0.05,
+              consistency: str = "linearizable") -> dict:
         """Submit-and-wait convenience: returns the terminal request
         record (results included). Waits server-side in bounded slices
         so one slow verdict cannot park the connection past the
         daemon's handler cap."""
-        rec = self.submit(histories, workload=workload, algorithm=algorithm)
+        rec = self.submit(histories, workload=workload, algorithm=algorithm,
+                          consistency=consistency)
         if rec.get("status") in ("done", "failed", "cancelled"):
             return self.result(rec["id"])
         deadline = time.monotonic() + timeout_s

@@ -634,22 +634,26 @@ class CheckingService:
 
     def submit(self, histories: Sequence, workload: str = "register",
                algorithm: str = "auto", deadline_ms: Optional[float] = None,
-               priority: int = 0) -> CheckRequest:
+               priority: int = 0,
+               consistency: str = "linearizable") -> CheckRequest:
         """Admit a submission; returns its CheckRequest (already DONE on
         a cache hit). Raises QueueFull with a retry-after estimate when
-        the queue is at capacity, ValueError on malformed input."""
+        the queue is at capacity, ValueError on malformed input (unknown
+        workload/consistency included)."""
         req = admit(histories, workload, algorithm=algorithm,
-                    deadline_ms=deadline_ms, priority=priority)
+                    deadline_ms=deadline_ms, priority=priority,
+                    consistency=consistency)
         return self._admit(req)
 
     def submit_run_dir(self, run_dir, algorithm: str = "auto",
                        deadline_ms: Optional[float] = None,
                        priority: int = 0,
-                       workload: Optional[str] = None) -> CheckRequest:
+                       workload: Optional[str] = None,
+                       consistency: str = "linearizable") -> CheckRequest:
         """Admit a recorded-run directory (store/<name>/<ts>/)."""
         req = admit_run_dir(run_dir, algorithm=algorithm,
                             deadline_ms=deadline_ms, priority=priority,
-                            workload=workload)
+                            workload=workload, consistency=consistency)
         return self._admit(req)
 
     def _admit(self, req: CheckRequest) -> CheckRequest:

@@ -5,7 +5,8 @@ records feed).
 Endpoints::
 
     POST /submit   {"workload": "register", "histories": [[op…]…],
-                    "algorithm"?, "deadline_ms"?, "priority"?,
+                    "algorithm"?, "consistency"?, "deadline_ms"?,
+                    "priority"?,
                     "run_dir"?}        → 200 {"id", "status", …}
                                        → 429 {"error", "retry_after_s"}
                                          (+ Retry-After header)
@@ -143,7 +144,9 @@ class _Handler(BaseHTTPRequestHandler):
             # not an aborted connection.
             kwargs = {"algorithm": str(body.get("algorithm", "auto")),
                       "deadline_ms": body.get("deadline_ms"),
-                      "priority": int(body.get("priority", 0))}
+                      "priority": int(body.get("priority", 0)),
+                      "consistency": str(body.get("consistency",
+                                                  "linearizable"))}
             if body.get("run_dir"):
                 req = self.service.submit_run_dir(
                     str(body["run_dir"]), workload=body.get("workload"),

@@ -112,6 +112,7 @@ def encode_submit(req: CheckRequest) -> dict:
         "workload": req.workload,
         "model": type(req.model).__name__,
         "algorithm": req.algorithm,
+        "consistency": req.consistency,
         "fingerprint": req.fingerprint,
         "priority": req.priority,
         "deadline_wall": now_wall + (req.deadline - now_mono),
@@ -123,6 +124,12 @@ def encode_submit(req: CheckRequest) -> dict:
             "events_shape": list(enc.events.shape),
             "events": _b64(enc.events),
             "op_index": _b64(enc.op_index),
+            # proc rides along when present: the weaker-consistency
+            # rungs relax along per-process order, and a replayed
+            # request must reach the same relaxed stream (a missing
+            # proc degrades the rung to the conservative identity
+            # relaxation — sound, but stricter than promised).
+            **({"proc": _b64(enc.proc)} if enc.proc is not None else {}),
         } for (label, _), enc in zip(req.units, req.encs)],
     }
 
@@ -164,15 +171,18 @@ def decode_request(rec: dict) -> CheckRequest:
     for u in rec["units"]:
         events = _unb64(u["events"], u["events_shape"])
         op_index = _unb64(u["op_index"], (u["events_shape"][0],))
+        proc = (_unb64(u["proc"], (u["events_shape"][0],))
+                if u.get("proc") is not None else None)
         units.append((u["label"], History()))
         encs.append(EncodedHistory(events=events, op_index=op_index,
                                    n_slots=int(u["n_slots"]),
-                                   n_ops=int(u["n_ops"])))
+                                   n_ops=int(u["n_ops"]), proc=proc))
     return CheckRequest(
         id=rec["id"],
         workload=rec["workload"],
         model=model_cls(),
         algorithm=rec["algorithm"],
+        consistency=rec.get("consistency", "linearizable"),
         units=units,
         encs=encs,
         fingerprint=rec["fingerprint"],

@@ -47,13 +47,15 @@ MAX_PRIORITY = 8
 #: unit per key. "register"/"counter" accept plain single-key histories
 #: — the shape tests and the bench submit.
 def service_workloads() -> dict:
-    from ..models import CasRegister, Counter
+    from ..models import CasRegister, Counter, GSet, TicketQueue
 
     return {
         "register": (CasRegister, False),
         "counter": (Counter, False),
         "single-register": (CasRegister, True),
         "multi-register": (CasRegister, True),
+        "set": (GSet, False),
+        "queue": (TicketQueue, False),
     }
 
 
@@ -71,20 +73,37 @@ def history_from_dicts(rows: Sequence[dict]) -> History:
 
 
 def fingerprint_encodings(model, algorithm: str,
-                          encs: Sequence[EncodedHistory]) -> str:
+                          encs: Sequence[EncodedHistory],
+                          consistency: str = "linearizable") -> str:
     """Content hash over the packed arrays of a submission — the result
     cache key. Hashing the ENCODING (not the op dicts) makes the cache
     insensitive to wire-level noise that cannot change the verdict
     (timestamps, op indices of dropped fail ops) while staying sound:
-    the encoded event stream is exactly the checker's input."""
+    the encoded event stream is exactly the checker's input. The
+    consistency rung is part of the identity: the same bytes checked at
+    a weaker rung are a DIFFERENT verdict — and at a weaker rung the
+    per-event process ids are hashed too, because the relaxation defers
+    FORCEs along per-process order, so two submissions with identical
+    event rows but different proc arrays genuinely have different
+    verdicts there (at the linearizable rung proc is inert and stays
+    out of the hash, preserving wire-noise insensitivity)."""
     h = hashlib.sha256()
     h.update(type(model).__name__.encode())
     h.update(b"\x00")
     h.update(algorithm.encode())
+    weak = consistency != "linearizable"
+    if weak:
+        h.update(b"\x00")
+        h.update(consistency.encode())
     for e in encs:
         h.update(np.asarray(e.events.shape, dtype=np.int64).tobytes())
         h.update(np.ascontiguousarray(e.events).tobytes())
         h.update(np.int64(e.n_slots).tobytes())
+        if weak:
+            h.update(b"\x01" if e.proc is not None else b"\x00")
+            if e.proc is not None:
+                h.update(np.ascontiguousarray(
+                    np.asarray(e.proc, dtype=np.int32)).tobytes())
     return h.hexdigest()
 
 
@@ -113,6 +132,11 @@ class CheckRequest:
     deadline: float
     submitted: float
     priority: int = 0
+    #: consistency ladder rung (checker/consistency.py): part of the
+    #: bucket signature (same-rung requests coalesce) and the result
+    #: fingerprint; the checker relaxes per batch, so admission keeps
+    #: the canonical linearizable encoding.
+    consistency: str = "linearizable"
     status: str = QUEUED
     results: Optional[List[dict]] = None
     error: Optional[str] = None
@@ -189,6 +213,7 @@ class CheckRequest:
             "status": self.status,
             "workload": self.workload,
             "algorithm": self.algorithm,
+            "consistency": self.consistency,
             "units": [label for label, _ in self.units],
             "fingerprint": self.fingerprint,
             "priority": self.priority,
@@ -211,11 +236,16 @@ class CheckRequest:
 def admit(histories: Sequence, workload: str, algorithm: str = "auto",
           deadline_ms: Optional[float] = None, priority: int = 0,
           default_deadline_s: float = 3600.0,
-          request_id: Optional[str] = None) -> CheckRequest:
+          request_id: Optional[str] = None,
+          consistency: str = "linearizable") -> CheckRequest:
     """Normalize a submission into a CheckRequest (encode once +
     fingerprint). `histories` items are History objects or op-dict
-    lists. Raises ValueError on unknown workloads / malformed ops — the
-    HTTP surface maps that to 400, never into the queue."""
+    lists. Raises ValueError on unknown workloads / malformed ops /
+    unknown consistency rungs — the HTTP surface maps that to 400,
+    never into the queue."""
+    from ..checker.consistency import normalize_consistency
+
+    consistency = normalize_consistency(consistency)
     workloads = service_workloads()
     if workload not in workloads:
         raise ValueError(f"unknown workload {workload!r} "
@@ -248,10 +278,12 @@ def admit(histories: Sequence, workload: str, algorithm: str = "auto",
         algorithm=algorithm,
         units=units,
         encs=encs,
-        fingerprint=fingerprint_encodings(model, algorithm, encs),
+        fingerprint=fingerprint_encodings(model, algorithm, encs,
+                                          consistency),
         deadline=deadline,
         submitted=now,
         priority=clamp_priority(priority),
+        consistency=consistency,
     )
 
 
@@ -262,14 +294,17 @@ def clamp_priority(priority) -> int:
 def admit_run_dir(run_dir, algorithm: str = "auto",
                   deadline_ms: Optional[float] = None, priority: int = 0,
                   workload: Optional[str] = None,
-                  default_deadline_s: float = 3600.0) -> CheckRequest:
+                  default_deadline_s: float = 3600.0,
+                  consistency: str = "linearizable") -> CheckRequest:
     """Admit a recorded-run directory (store/<name>/<ts>/): load the
     stored history, split per key exactly like `checker/recorded.py`,
     and check it as one request. The service's re-verification surface
     for artifacts a live run already produced."""
+    from ..checker.consistency import normalize_consistency
     from ..checker.recorded import load_run_histories
     from ..models.base import Model
 
+    consistency = normalize_consistency(consistency)
     model, subs, wl = load_run_histories(run_dir, workload)
     if not isinstance(model, Model):
         raise ValueError(
@@ -287,8 +322,10 @@ def admit_run_dir(run_dir, algorithm: str = "auto",
         algorithm=algorithm,
         units=units,
         encs=encs,
-        fingerprint=fingerprint_encodings(model, algorithm, encs),
+        fingerprint=fingerprint_encodings(model, algorithm, encs,
+                                          consistency),
         deadline=deadline,
         submitted=now,
         priority=clamp_priority(priority),
+        consistency=consistency,
     )

@@ -131,13 +131,19 @@ def bucket_signature(req: CheckRequest) -> tuple:
 
     Two requests with the same signature ride one `check_encoded` batch
     whose group packing pads them into shared jit-cache shapes: same
-    model family (one kernel family), same algorithm, and the same
-    pow2+midpoint EVENT bucket (`bucket_rows(E, 32)` — the floor_e=32
-    series `pad_batch_bucketed` pads short groups to). Window grouping
-    inside the checker re-buckets rows further by concurrency window;
-    that is invisible here because it happens after concatenation."""
+    model family (one kernel family), same algorithm, the same
+    consistency rung (a weaker rung relaxes the WHOLE batch's streams
+    before the kernels see them — checker/consistency.py — so mixed
+    rungs cannot share a launch), and the same pow2+midpoint EVENT
+    bucket (`bucket_rows(E, 32)` — the floor_e=32 series
+    `pad_batch_bucketed` pads short groups to). Window grouping inside
+    the checker re-buckets rows further by concurrency window; that is
+    invisible here because it happens after concatenation. Mixed-MODEL
+    submissions need no scheduler changes: different models simply form
+    different buckets, each riding the same formation/linger/execute
+    machinery (the ISSUE-10 acceptance row pins this)."""
     e_max = max((e.n_events for e in req.encs), default=0)
-    return (type(req.model).__name__, req.algorithm,
+    return (type(req.model).__name__, req.algorithm, req.consistency,
             bucket_rows(max(e_max, 1), 32))
 
 
@@ -152,7 +158,8 @@ class BatchScheduler:
                  aging_cap_s: float = AGING_CAP_S):
         from ..checker.linearizable import check_encoded, check_encoded_host
 
-        def _check_local(encs, model, algorithm="auto"):
+        def _check_local(encs, model, algorithm="auto",
+                         consistency="linearizable"):
             # distribute=False: graftd's admission queue is HOST-local
             # — different daemon processes hold different batches, so
             # the cross-host SPMD seam (which barriers on every process
@@ -161,7 +168,8 @@ class BatchScheduler:
             # instead: one daemon per host, each with its own workers
             # (doc/checker-design.md §10).
             return check_encoded(encs, model, algorithm=algorithm,
-                                 distribute=False)
+                                 distribute=False,
+                                 consistency=consistency)
 
         #: device-path seam (tests inject failures / gates here).
         self.check_fn = check_fn or _check_local
@@ -271,6 +279,12 @@ class BatchScheduler:
         encs = [e for r in live for e in r.encs]
         model = live[0].model
         algorithm = live[0].algorithm
+        consistency = live[0].consistency
+        # Weaker-rung batches pass the knob through; the default rung
+        # keeps the historical check_fn arity (injected seams predate
+        # the consistency parameter).
+        check_kw = ({"consistency": consistency}
+                    if consistency != "linearizable" else {})
         label = "graftd:" + ",".join(r.id for r in live)
         degraded_note_local = None
         # Autotune consult marker (PR 6): the checker applies per-bucket
@@ -297,7 +311,8 @@ class BatchScheduler:
                     raise WatchdogDegrade(
                         "hung batch exceeded its deadline twice; "
                         "watchdog forced the host ladder")
-                results = self.check_fn(encs, model, algorithm=algorithm)
+                results = self.check_fn(encs, model, algorithm=algorithm,
+                                        **check_kw)
             except Exception as e:
                 # Device path died mid-check (tunnel drop, backend
                 # teardown, injected fault): degrade THIS batch to the
@@ -319,7 +334,8 @@ class BatchScheduler:
                     f"{type(e).__name__}: {e}"[:300])
                 if is_backend_init_failure(e):
                     note_degraded(degraded_note_local)
-                results = [self.host_fallback(enc, model) for enc in encs]
+                results = [self.host_fallback(enc, model, **check_kw)
+                           for enc in encs]
                 for res in results:
                     res["platform-degraded"] = degraded_note_local
         wall = time.monotonic() - t0
@@ -346,7 +362,39 @@ class BatchScheduler:
             elif any(res is None for res in mine):
                 r.finish(FAILED, error="checker returned no verdict")
             else:
+                self._attach_counterexamples(r, mine)
                 r.finish(DONE, results=mine)
         return {"requests": len(live), "rows": len(encs),
                 "degraded": degraded_note_local is not None,
                 "wall_s": wall, "seq": seq}
+
+    #: Skip counterexample minimization for units beyond this many ops:
+    #: the greedy pair-drop is bounded anyway (counterexample.py caps),
+    #: but even the suffix-truncation re-search costs a CPU frontier
+    #: pass — a tenant submitting huge invalid histories should not
+    #: stall the shard's demux.
+    MAX_COUNTEREXAMPLE_OPS = 2048
+
+    def _attach_counterexamples(self, r: CheckRequest, mine: list) -> None:
+        """ISSUE-10 satellite: a `fail` verdict leaving graftd (result
+        record AND trace record — the daemon writes traces from the
+        same result lists) carries the minimized witness
+        (checker/counterexample.py), not a raw op dump. Best-effort:
+        explanation failures must never take down a sound verdict."""
+        from ..checker.base import INVALID
+        from ..checker.counterexample import attach_counterexample
+        from ..checker.linearizable import DEFAULT_MAX_CPU_CONFIGS
+
+        for (label, hist), res in zip(r.units, mine):
+            if res.get("valid?") is not INVALID:
+                continue
+            if res.get("op-count", 0) > self.MAX_COUNTEREXAMPLE_OPS:
+                continue
+            try:
+                attach_counterexample(res, hist, r.model,
+                                      max_cpu_configs=
+                                      DEFAULT_MAX_CPU_CONFIGS,
+                                      consistency=r.consistency)
+            except Exception:
+                LOG.warning("counterexample attach failed for %s/%s",
+                            r.id, label, exc_info=True)

@@ -9,6 +9,8 @@ dict with client / checker / generator / idempotent keys (the
 from .register import register_workload
 from .counter import counter_workload
 from .leader import leader_workload
+from .set import set_workload
+from .queue import queue_workload
 
 
 def single_register(opts):
@@ -16,15 +18,21 @@ def single_register(opts):
 
 
 def multi_register(opts):
+    """Independent multi-key registers (generator/independent.py
+    concurrent generator; checker: one cross-key batched kernel launch
+    via checker/independent.check_keyed)."""
     import itertools
 
     return register_workload({**opts, "keys": itertools.count()})
 
 
-#: name → constructor (reference workload.clj:10-15).
+#: name → constructor (reference workload.clj:10-15; set/queue are the
+#: ISSUE-10 scenario tier).
 WORKLOADS = {
     "single-register": single_register,
     "multi-register": multi_register,
     "counter": counter_workload,
     "election": leader_workload,
+    "set": set_workload,
+    "queue": queue_workload,
 }

@@ -95,7 +95,8 @@ def counter_workload(opts: dict) -> dict:
             # envelope runs (concurrency 100 hell) pile up thousands of
             # crashed adds and blow the window past every engine.
             "linear": CounterChecker(LinearizableChecker(
-                Counter(0), algorithm=opts.get("algorithm", "auto"))),
+                Counter(0), algorithm=opts.get("algorithm", "auto"),
+                consistency=opts.get("consistency", "linearizable"))),
         }),
         "generator": gen,
         "idempotent": {"read"},  # counter.clj:80

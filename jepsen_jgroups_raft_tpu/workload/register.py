@@ -99,7 +99,8 @@ def register_workload(opts: dict) -> dict:
             "stats": StatsChecker(),
             "linear": IndependentLinearizable(
                 CasRegister,
-                algorithm=opts.get("algorithm", "auto")),
+                algorithm=opts.get("algorithm", "auto"),
+                consistency=opts.get("consistency", "linearizable")),
         }),
         "generator": gen,
         "idempotent": {"read"},  # register.clj:72

@@ -1,0 +1,317 @@
+"""Weaker-consistency rung family (ISSUE 10): relaxation soundness,
+greedy certifier soundness, rung-ordering properties, and the
+``consistency=`` knob through the checker and graftd surfaces.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker.consistency import (
+    CONSISTENCY_LEVELS, greedy_certify, normalize_consistency,
+    relax_encoded, rung_index)
+from jepsen_jgroups_raft_tpu.checker.linearizable import (
+    check_encoded_host, check_histories)
+from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
+from jepsen_jgroups_raft_tpu.history.packing import (EV_FORCE, EV_OPEN,
+                                                     encode_history)
+from jepsen_jgroups_raft_tpu.models import (CasRegister, Counter, GSet,
+                                            TicketQueue)
+
+from util import H, corrupt, random_valid_history
+
+MODELS = {
+    "register": CasRegister,
+    "counter": Counter,
+    "set": GSet,
+    "queue": TicketQueue,
+}
+
+
+def test_normalize_and_order():
+    assert normalize_consistency(None) == "linearizable"
+    assert normalize_consistency("seq") == "sequential"
+    assert normalize_consistency("monotonic-reads") == "session"
+    assert [rung_index(c) for c in CONSISTENCY_LEVELS] == [0, 1, 2]
+    with pytest.raises(ValueError):
+        normalize_consistency("eventual")
+
+
+# -------------------------------------------------- relaxation structure
+
+
+@pytest.mark.parametrize("kind", ["register", "set", "queue"])
+def test_relaxation_preserves_ops_and_monotonicity(kind):
+    """The relaxed stream re-encodes the SAME ops (op_index multiset,
+    OPEN payloads, force set) and both rungs keep every OPEN's relative
+    order — relaxation is a FORCE move, not an op rewrite."""
+    rng = random.Random(3)
+    model = MODELS[kind]()
+
+    def force_ids(e):
+        return sorted(int(e.op_index[i]) for i in range(e.n_events)
+                      if e.events[i, 0] == EV_FORCE)
+
+    def open_rows(e):
+        return [tuple(r) for r in
+                e.events[e.events[:, 0] == EV_OPEN][:, 2:5].tolist()]
+
+    for _ in range(5):
+        h = random_valid_history(rng, kind, n_ops=14, crash_p=0.2)
+        enc = encode_history(h, model)
+        seq = relax_encoded(enc, model, "sequential")
+        ses = relax_encoded(enc, model, "session")
+        for rel in (seq, ses):
+            assert rel.n_ops == enc.n_ops
+            assert rel.n_events == enc.n_events
+            assert sorted(rel.op_index.tolist()) == \
+                sorted(enc.op_index.tolist())
+            # opens keep their relative (real-time) order exactly
+            assert open_rows(rel) == open_rows(enc)
+            assert force_ids(rel) == force_ids(enc)
+
+
+def test_relaxation_without_proc_is_identity():
+    from jepsen_jgroups_raft_tpu.history.packing import EncodedHistory
+
+    m = CasRegister()
+    enc = encode_history(
+        H((0, "invoke", "write", 1), (0, "ok", "write", 1)), m)
+    stripped = EncodedHistory(events=enc.events, op_index=enc.op_index,
+                              n_slots=enc.n_slots, n_ops=enc.n_ops)
+    assert relax_encoded(stripped, m, "sequential") is stripped
+
+
+# ------------------------------------------------------- rung ordering
+
+
+@pytest.mark.parametrize("kind", ["register", "set", "queue"])
+def test_rung_ordering_property(kind):
+    """Any history passing linearizability passes every weaker rung;
+    any rung pass implies every weaker rung passes too."""
+    rng = random.Random(13)
+    model = MODELS[kind]()
+    seen_valid = seen_invalid = False
+    for i in range(12):
+        h = random_valid_history(rng, kind, n_ops=10, n_procs=3,
+                                 crash_p=0.15)
+        if i % 3 == 0:
+            h = corrupt(rng, h)
+        verdicts = [
+            check_histories([h], model, consistency=c)[0]["valid?"]
+            for c in CONSISTENCY_LEVELS
+        ]
+        for strong, weak in zip(verdicts, verdicts[1:]):
+            if strong is True:
+                assert weak is True, (kind, i, verdicts)
+        seen_valid |= verdicts[0] is True
+        seen_invalid |= verdicts[0] is False
+    assert seen_valid  # the property was not vacuous
+
+
+def test_sequential_separates_from_linearizable():
+    """The seeded stale-read history: sequentially consistent (per-
+    process order has a witness) but NOT linearizable (real-time order
+    forbids it) — the rung-separation acceptance row."""
+    h = H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "write", 2), (0, "ok", "write", 2),
+        (1, "invoke", "read", None), (1, "ok", "read", 1),
+    )
+    m = CasRegister()
+    lin = check_histories([h], m)[0]
+    seq = check_histories([h], m, consistency="sequential")[0]
+    ses = check_histories([h], m, consistency="session")[0]
+    assert lin["valid?"] is False
+    assert seq["valid?"] is True and seq["consistency"] == "sequential"
+    assert ses["valid?"] is True and ses["consistency"] == "session"
+
+
+def test_rung_fail_certifies_non_linearizability():
+    """A weaker-rung FAIL implies the linearizable verdict is FAIL too
+    (contrapositive of monotone relaxation) — checked on histories the
+    rung actually rejects."""
+    rng = random.Random(29)
+    m = CasRegister()
+    rejected = 0
+    for _ in range(30):
+        h = corrupt(rng, random_valid_history(rng, "register", n_ops=10,
+                                              crash_p=0.0))
+        seq = check_histories([h], m, consistency="sequential")[0]
+        if seq["valid?"] is False:
+            rejected += 1
+            lin = check_histories([h], m)[0]
+            assert lin["valid?"] is False
+    assert rejected > 0  # the check was not vacuous
+
+
+# --------------------------------------------------- greedy certifier
+
+
+def test_greedy_certify_is_sound():
+    """greedy True ⇒ the CPU oracle agrees VALID, on the same stream."""
+    rng = random.Random(7)
+    for kind, factory in MODELS.items():
+        model = factory()
+        certified = 0
+        for i in range(15):
+            h = random_valid_history(rng, kind, n_ops=12, crash_p=0.2)
+            if i % 2:
+                h = corrupt(rng, h)
+            enc = encode_history(h, model)
+            if greedy_certify(enc, model):
+                certified += 1
+                assert check_encoded_cpu(enc, model).valid, (kind, i)
+        assert certified > 0, kind  # certifier exercised
+
+
+def test_greedy_ablation_verdicts_identical(monkeypatch):
+    rng = random.Random(19)
+    m = GSet()
+    hists = [random_valid_history(rng, "set", n_ops=10, crash_p=0.1)
+             for _ in range(4)]
+    # SAME-process violation (program order binds even at the weakest
+    # rung): p0 acked add(1) and then read an empty set.
+    hists.append(H(
+        (0, "invoke", "add", 1), (0, "ok", "add", 1),
+        (0, "invoke", "read", None), (0, "ok", "read", []),
+    ))
+    on = [r["valid?"] for r in
+          check_histories(hists, m, consistency="sequential")]
+    monkeypatch.setenv("JGRAFT_GREEDY_CERTIFY", "0")
+    off = [r["valid?"] for r in
+           check_histories(hists, m, consistency="sequential")]
+    assert on == off
+    assert False in on and True in on
+
+
+def test_check_encoded_host_supports_rungs():
+    m = CasRegister()
+    h = H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "write", 2), (0, "ok", "write", 2),
+        (1, "invoke", "read", None), (1, "ok", "read", 1),
+    )
+    enc = encode_history(h, m)
+    assert check_encoded_host(enc, m)["valid?"] is False
+    r = check_encoded_host(enc, m, consistency="sequential")
+    assert r["valid?"] is True and r["consistency"] == "sequential"
+
+
+# ------------------------------------------------------- service knob
+
+
+def test_consistency_threads_through_service():
+    from jepsen_jgroups_raft_tpu.service import CheckingService
+    from jepsen_jgroups_raft_tpu.service.request import admit
+    from jepsen_jgroups_raft_tpu.service.scheduler import bucket_signature
+
+    h = H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "write", 2), (0, "ok", "write", 2),
+        (1, "invoke", "read", None), (1, "ok", "read", 1),
+    )
+    lin = admit([h], "register")
+    seq = admit([h], "register", consistency="sequential")
+    assert lin.fingerprint != seq.fingerprint
+    assert bucket_signature(lin) != bucket_signature(seq)
+    assert seq.to_dict()["consistency"] == "sequential"
+    with pytest.raises(ValueError):
+        admit([h], "register", consistency="eventual")
+
+    svc = CheckingService(store_root=None, autostart=True)
+    try:
+        r_lin = svc.submit([h], workload="register")
+        r_seq = svc.submit([h], workload="register",
+                           consistency="sequential")
+        assert r_lin.wait(60) and r_seq.wait(60)
+        assert r_lin.verdict() is False
+        assert r_seq.verdict() is True
+        assert r_seq.results[0]["consistency"] == "sequential"
+    finally:
+        svc.shutdown(wait=True)
+
+
+def test_weak_rung_fingerprint_keys_on_proc():
+    """At a weaker rung the per-event process ids determine the verdict
+    (relaxation defers FORCEs along per-process order), so identical
+    event rows with different proc arrays must NOT share a cache
+    fingerprint — while at the linearizable rung proc is inert and the
+    wire-noise-insensitive fingerprint stays proc-free."""
+    from jepsen_jgroups_raft_tpu.service.request import admit
+
+    same = H(  # p0 acked write(1) then read the nil initial: seq-invalid
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "read", None), (0, "ok", "read", None),
+    )
+    cross = H(  # the read on another process may order first: seq-valid
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (1, "invoke", "read", None), (1, "ok", "read", None),
+    )
+    m_same = admit([same], "register", consistency="sequential")
+    m_cross = admit([cross], "register", consistency="sequential")
+    # identical packed event rows, different proc arrays
+    assert (m_same.encs[0].events == m_cross.encs[0].events).all()
+    assert m_same.fingerprint != m_cross.fingerprint
+    # and the verdicts genuinely differ at the rung
+    r_same = check_histories([same], CasRegister(),
+                             consistency="sequential")[0]
+    r_cross = check_histories([cross], CasRegister(),
+                              consistency="sequential")[0]
+    assert r_same["valid?"] is False and r_cross["valid?"] is True
+    # linearizable rung: proc inert, fingerprints insensitive to it
+    l_same = admit([same], "register")
+    l_cross = admit([cross], "register")
+    assert l_same.fingerprint == l_cross.fingerprint
+
+
+def test_minimized_witness_reverifies_at_its_rung():
+    """counterexample.minimal-ops must itself be INVALID at the rung
+    that produced the verdict — every reduction is re-checked, so a
+    'reproducer' can never be a passing history."""
+    from jepsen_jgroups_raft_tpu.checker.counterexample import \
+        attach_counterexample
+    from jepsen_jgroups_raft_tpu.history.ops import History, Op
+
+    rng = random.Random(37)
+    m = GSet()
+    attached = 0
+    for _ in range(20):
+        h = corrupt(rng, random_valid_history(rng, "set", n_ops=12,
+                                              crash_p=0.1))
+        for rung in ("sequential", "linearizable"):
+            [r] = check_histories([h], m, consistency=rung)
+            if r["valid?"] is not False:
+                continue
+            attach_counterexample(r, h, m, consistency=rung)
+            mo = r.get("counterexample", {}).get("minimal-ops")
+            if not mo:
+                continue
+            attached += 1
+            mini = History([Op(process=v["process"], type=v["type"],
+                               f=v["f"], value=v["value"],
+                               index=v["index"]) for v in mo])
+            [rv] = check_histories([mini], m, consistency=rung)
+            assert rv["valid?"] is False, (rung, mo)
+    assert attached > 0  # the property was exercised
+
+
+def test_journal_round_trips_consistency_and_proc():
+    import numpy as np
+
+    from jepsen_jgroups_raft_tpu.service.journal import (decode_request,
+                                                         encode_submit)
+    from jepsen_jgroups_raft_tpu.service.request import admit
+
+    h = H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (1, "invoke", "read", None), (1, "ok", "read", 1),
+    )
+    req = admit([h], "register", consistency="session")
+    back = decode_request(encode_submit(req))
+    assert back.consistency == "session"
+    assert back.fingerprint == req.fingerprint
+    assert back.encs[0].proc is not None
+    assert np.array_equal(back.encs[0].proc, req.encs[0].proc)

@@ -227,7 +227,23 @@ class TestCrashRecovery:
         svc1 = make_service(store_root=str(tmp_path))
         req = svc1.submit([h], workload="register")
         assert req.wait(WAIT_S) and req.status == "done"
-        del svc1  # SIGKILL after completion, before any client read
+        # The worker appends the WAL terminal marker AFTER finish() (the
+        # client-visible wait), so a kill in that window legitimately
+        # replays the request for re-execution (at-least-once, §11).
+        # This test asserts the durable-marker half of the contract —
+        # wait until the marker is on disk before the simulated kill.
+        wal = svc1._journal.path
+        needle = f'"id":"{req.id}"'
+        deadline = time.monotonic() + WAIT_S
+        while time.monotonic() < deadline:
+            text = wal.read_text() if wal.exists() else ""
+            if any(needle in ln and '"kind":"terminal"' in ln
+                   for ln in text.splitlines()):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("terminal marker never reached the WAL")
+        del svc1  # SIGKILL after the marker landed, before any client read
 
         svc2 = make_service(store_root=str(tmp_path), autostart=False)
         try:
