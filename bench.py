@@ -878,6 +878,8 @@ def run_service(platform_note: str) -> None:
     """ISSUE-5 service throughput mode (`python bench.py --service`):
     drive graftd over its real HTTP surface with sustained concurrent
     submissions and report req/s + queue/batching/latency evidence.
+    `--replicas N` (ISSUE 11) switches to the clustered mode below —
+    the single-replica path is byte-for-byte unchanged without it.
 
     Shape knobs (env): JGRAFT_SERVICE_BENCH_REQUESTS total requests per
     rep (default 64), _HISTORIES per request (default 4), _OPS per
@@ -898,6 +900,15 @@ def run_service(platform_note: str) -> None:
                                                  ServiceClient, ServiceError,
                                                  journal_enabled,
                                                  serve_in_thread)
+
+    if "--replicas" in sys.argv:
+        try:
+            n_replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
+        except (ValueError, IndexError):
+            n_replicas = 1
+        if n_replicas > 1:
+            run_service_cluster(platform_note, n_replicas)
+            return
 
     n_requests = int(os.environ.get("JGRAFT_SERVICE_BENCH_REQUESTS", "64"))
     n_hists = int(os.environ.get("JGRAFT_SERVICE_BENCH_HISTORIES", "4"))
@@ -1046,6 +1057,261 @@ def run_service(platform_note: str) -> None:
         "recovered_requests": stats["recovered_requests"],
         # Same host-drift armor as the batch rows (ISSUE-4 satellites):
         # best rep + full spread + cold/warm split + host fingerprint.
+        "rep_times_s": [round(t, 3) for t in rep_times],
+        **cold_warm(rep_times),
+        "host_fingerprint": host_fingerprint(),
+        "probe_error": _PROBE_ERROR,
+        "autotune_plan": autotune_report(),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "platform_note": platform_note,
+    })
+
+
+def run_service_cluster(platform_note: str, n_replicas: int) -> None:
+    """ISSUE-11 clustered service mode (`bench.py --service --replicas
+    N`): N in-process replicas sharing one cluster dir (content-
+    addressed result store + leases + per-replica journals), driven
+    through the cluster-routing client. Three phases per run:
+
+    1. the timed saturation wave (best-of-reps like every bench row) —
+       each wave submits FRESH payloads so the shared store cannot
+       convert the scheduler benchmark into a store benchmark; reports
+       global req/s plus per-replica req/s;
+    2. cross-replica cache: the measured wave's payloads are resubmitted
+       once to EVERY replica directly — each must answer from the shared
+       store without a kernel launch (store_hits counted, zero new
+       batches), the ISSUE-11 acceptance counter;
+    3. failover: replica 0 is shut down and fresh payloads are submitted
+       through a client whose route starts at the dead replica —
+       failover_latency_p99 prices the detour.
+
+    Same host-drift armor as every service row: cold/warm split, rep
+    spread, host fingerprint."""
+    import random as _random
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.service import (CheckingService,
+                                                 ServiceClient,
+                                                 ServiceError,
+                                                 serve_in_thread)
+
+    n_requests = int(os.environ.get("JGRAFT_SERVICE_BENCH_REQUESTS", "64"))
+    n_hists = int(os.environ.get("JGRAFT_SERVICE_BENCH_HISTORIES", "4"))
+    n_ops = int(os.environ.get("JGRAFT_SERVICE_BENCH_OPS", "200"))
+    n_clients = int(os.environ.get("JGRAFT_SERVICE_BENCH_CLIENTS", "8"))
+
+    rng = _random.Random(20260804)
+    cluster_tmp = tempfile.mkdtemp(prefix="graftd-bench-cluster-")
+
+    def rm_cluster_tmp():
+        shutil.rmtree(cluster_tmp, ignore_errors=True)
+
+    # cache_capacity=0 like the single-replica row (the LRU has its own
+    # coverage; reps must measure scheduling) — the SHARED store stays
+    # on: it is the thing this row exists to price, and phase 1's
+    # fresh-payloads-per-wave rule keeps it off the saturation clock.
+    services, fronts = [], []
+    for k in range(n_replicas):
+        svc = CheckingService(store_root=None, name=f"graftd-bench-r{k}",
+                              cache_capacity=0, cluster_dir=cluster_tmp,
+                              replica_id=f"r{k}", lease_ttl_s=10.0)
+        httpd, port, _t = serve_in_thread(svc)
+        svc.cluster.set_url(f"http://127.0.0.1:{port}")
+        services.append(svc)
+        fronts.append(httpd)
+        _CLEANUP.append(httpd.server_close)
+        _CLEANUP.append(svc.shutdown)
+    _CLEANUP.append(rm_cluster_tmp)
+    urls = [s.cluster.url for s in services]
+
+    def fresh_payloads():
+        pool = [random_valid_history(rng, "register", n_ops=n_ops,
+                                     n_procs=5, crash_p=0.05,
+                                     max_crashes=3)
+                for _ in range(n_requests * n_hists)]
+        return [pool[i * n_hists:(i + 1) * n_hists]
+                for i in range(n_requests)]
+
+    last_payloads: list = []
+
+    def wave():
+        """One rep over the fleet: payload synthesis happens BEFORE the
+        clock starts; n_clients submitters route through the cluster
+        client (affinity-first) and await every verdict."""
+        payloads = fresh_payloads()
+        last_payloads[:] = payloads
+        s0 = [s.stats() for s in services]
+        latencies: list = []
+        rejected = [0]
+        lock = threading.Lock()
+        idx = iter(range(n_requests))
+
+        def submitter():
+            cl = ServiceClient(urls[0], replicas=urls[1:], timeout=60.0)
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                t0 = time.perf_counter()
+                while True:
+                    try:
+                        rec = cl.submit(payloads[i], workload="register")
+                        break
+                    except ServiceError as e:
+                        if e.status != 429:
+                            raise
+                        with lock:
+                            rejected[0] += 1
+                        time.sleep(min(e.retry_after_s or 0.5, 2.0))
+                rec = cl.result(rec["id"], wait_s=60.0)
+                while rec["status"] not in ("done", "failed",
+                                            "cancelled"):
+                    rec = cl.result(rec["id"], wait_s=60.0)
+                assert rec["status"] == "done", rec
+                assert rec["valid?"] is True, rec
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=submitter, daemon=True)
+                   for _ in range(n_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        s1 = [s.stats() for s in services]
+        deltas = [{k: b[k] - a[k] for k in
+                   ("batches", "batched_requests", "completed",
+                    "cache_hits", "store_hits", "store_puts")}
+                  for a, b in zip(s0, s1)]
+        return wall, latencies, rejected[0], deltas
+
+    wave()  # warm-up: compile + fleet spin-up (uncounted, like run())
+    beat()
+    (wall, latencies, rejected, deltas), rep_times = best_of(wave)
+
+    # ---- phase 2: cross-replica cache hits over the measured payloads
+    s0 = [s.stats() for s in services]
+    cached_answers = 0
+    for url in urls:
+        direct = ServiceClient(url, timeout=60.0)
+        for payload in last_payloads:
+            rec = direct.submit(payload, workload="register")
+            if rec.get("cached"):
+                cached_answers += 1
+            else:  # pragma: no cover — would indicate a store miss
+                direct.result(rec["id"], wait_s=60.0)
+    s1 = [s.stats() for s in services]
+    resubmits = n_replicas * len(last_payloads)
+    store_hits_delta = sum(b["store_hits"] - a["store_hits"]
+                           for a, b in zip(s0, s1))
+    batches_during_resubmit = sum(b["batches"] - a["batches"]
+                                  for a, b in zip(s0, s1))
+    beat()
+
+    # ---- phase 3: failover — kill replica 0, route through its corpse
+    fronts[0].shutdown()
+    fronts[0].server_close()
+    services[0].shutdown(wait=True)
+    _CLEANUP.remove(fronts[0].server_close)
+    _CLEANUP.remove(services[0].shutdown)
+    n_failover = min(8, n_requests)
+    fo_payloads = [[random_valid_history(rng, "register", n_ops=n_ops,
+                                         n_procs=5, crash_p=0.0)]
+                   for _ in range(n_failover)]
+    fo_client = ServiceClient(urls[0], replicas=urls[1:],
+                              max_attempts=6, timeout=60.0)
+    fo_latencies = []
+    for payload in fo_payloads:
+        t0 = time.perf_counter()
+        # affinity=False pins the configured order — the DEAD replica
+        # leads every route, so every sample genuinely pays the
+        # failover detour the metric's name promises (rendezvous
+        # affinity would send ~1/N of payloads straight to a live
+        # replica and dilute the p99)
+        rec = fo_client.submit(payload, workload="register",
+                               affinity=False)
+        rec = fo_client.result(rec["id"], wait_s=60.0)
+        while rec["status"] not in ("done", "failed", "cancelled"):
+            rec = fo_client.result(rec["id"], wait_s=60.0)
+        assert rec["status"] == "done", rec
+        fo_latencies.append(time.perf_counter() - t0)
+    beat()
+
+    stats = [s.stats() for s in services]
+    for svc, front in zip(services[1:], fronts[1:]):
+        front.shutdown()
+        front.server_close()
+        svc.shutdown(wait=True)
+        _CLEANUP.remove(front.server_close)
+        _CLEANUP.remove(svc.shutdown)
+    rm_cluster_tmp()
+    _CLEANUP.remove(rm_cluster_tmp)
+
+    latencies.sort()
+    fo_latencies.sort()
+    p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    p99 = latencies[min(len(latencies) - 1,
+                        int(0.99 * len(latencies)))] if latencies else 0.0
+    fo_p99 = fo_latencies[min(len(fo_latencies) - 1,
+                              int(0.99 * len(fo_latencies)))] \
+        if fo_latencies else 0.0
+    batches = sum(d["batches"] for d in deltas)
+    batched = sum(d["batched_requests"] for d in deltas)
+    emit({
+        "metric": "service_requests_per_sec",
+        "value": round(n_requests / wall, 2),
+        "unit": "req/s",
+        "n_replicas": n_replicas,
+        "n_requests": n_requests,
+        "histories_per_request": n_hists,
+        "n_ops": n_ops,
+        "client_concurrency": n_clients,
+        "time_s": round(wall, 3),
+        "p50_latency_s": round(p50, 4),
+        "p99_latency_s": round(p99, 4),
+        # per-replica share of the measured wave (completed includes
+        # attached duplicates; the spread is the routing evidence)
+        "per_replica_req_s": [round(d["completed"] / wall, 2)
+                              for d in deltas],
+        "per_replica_completed": [d["completed"] for d in deltas],
+        "per_replica_batches": [d["batches"] for d in deltas],
+        # ISSUE-11 acceptance counters: every replica answered every
+        # other replica's fingerprints from the shared store, with no
+        # kernel launched during the resubmit sweep
+        "cross_replica_resubmits": resubmits,
+        "cross_replica_store_hits": store_hits_delta,
+        "cross_replica_cache_hit_rate": round(
+            cached_answers / resubmits, 4) if resubmits else 0.0,
+        "batches_during_resubmit": batches_during_resubmit,
+        "failover_latency_p99": round(fo_p99, 4),
+        "failover_requests": n_failover,
+        "failover_count": fo_client.failovers,
+        "queue_depth_hw": max(s["max_queue_depth"] for s in stats),
+        "queue_capacity": stats[0]["queue_capacity"],
+        "rejected_submissions": rejected,
+        "batches": batches,
+        "batched_requests": batched,
+        "batch_occupancy_mean": round(batched / batches, 3) if batches
+        else 0.0,
+        "cache_hits": sum(d["cache_hits"] for d in deltas),
+        "store_puts": sum(s["store_puts"] for s in stats),
+        "degraded_batches": sum(s["degraded_batches"] for s in stats),
+        "worker_restarts": sum(s["worker_restarts"] for s in stats),
+        "journal_enabled": stats[0]["journal_enabled"],
+        "journal_append_p50_ms": stats[0].get("journal_append_p50_ms"),
+        "recovered_requests": sum(s["recovered_requests"]
+                                  for s in stats),
+        "handoff_claims": sum(s["handoff_claims"] for s in stats),
         "rep_times_s": [round(t, 3) for t in rep_times],
         **cold_warm(rep_times),
         "host_fingerprint": host_fingerprint(),

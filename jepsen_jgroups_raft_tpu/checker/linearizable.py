@@ -222,7 +222,11 @@ def check_encoded(
         results = distributed.run_sharded(
             encs,
             lambda sub: _check_encoded(sub, model, algorithm, n_configs,
-                                       n_slots, witness, max_cpu_configs))
+                                       n_slots, witness, max_cpu_configs),
+            # the result-detail exchange (ISSUE 11 tentpole (d)) keys
+            # its store records over (model, algorithm, row encoding);
+            # inert unless a shared store dir is configured
+            model=model, algorithm=algorithm)
     else:
         results = _check_encoded(encs, model, algorithm, n_configs,
                                  n_slots, witness, max_cpu_configs)

@@ -234,7 +234,9 @@ def cmd_serve_checker(args) -> int:
                          batch_wait=(args.batch_wait_ms / 1000.0
                                      if args.batch_wait_ms is not None
                                      else None),
-                         n_workers=args.workers)
+                         n_workers=args.workers,
+                         cluster_dir=args.cluster_dir,
+                         replica_id=args.replica_id)
 
 
 def cmd_check(args) -> int:
@@ -295,6 +297,15 @@ def main(argv=None) -> int:
                          "(default: JGRAFT_SERVICE_WORKERS or 1)")
     sc.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="pin the JAX backend for checking")
+    sc.add_argument("--cluster-dir", default=None,
+                    help="shared cluster directory (result store + "
+                         "leases + per-replica journals; default: "
+                         "JGRAFT_SERVICE_CLUSTER_DIR or single-replica)")
+    sc.add_argument("--replica-id", default=None,
+                    help="stable replica identity inside the cluster "
+                         "dir (default: JGRAFT_SERVICE_REPLICA_ID; keep "
+                         "it stable across restarts so the replica "
+                         "replays its own journal)")
     sc.set_defaults(fn=cmd_serve_checker)
     c = sub.add_parser("check",
                        help="re-verify recorded runs as one device batch")

@@ -362,8 +362,11 @@ def _verdict_code(result: dict) -> int:
 
 def _remote_result(code: int, owner: int) -> dict:
     """Result stub for a row checked by another process: the verdict is
-    exact (it rode the wire), the explanation detail (witness, timing,
-    kernel tag) stays on the owning host's artifacts."""
+    exact (it rode the wire); without a shared result store the
+    explanation detail (witness, timing, kernel tag) stays on the
+    owning host's artifacts. With a store configured (ISSUE 11
+    tentpole (d)) `run_sharded` upgrades the stub from the owning
+    host's published detail record."""
     from ..checker.base import INVALID, UNKNOWN, VALID
 
     valid = (VALID if code == _CODE_VALID
@@ -372,14 +375,42 @@ def _remote_result(code: int, owner: int) -> dict:
             "kernel": "remote-shard", "process": owner}
 
 
+def _detail_exchange(model, algorithm: str):
+    """(store, key_fn) for the cross-host result-detail exchange, or
+    (None, None) — inert unless JGRAFT_RESULT_STORE (or the cluster
+    dir) names a directory every host shares, and only usable when the
+    caller supplied the model the detail keys hash over."""
+    if model is None:
+        return None, None
+    try:
+        from ..service.store import detail_fingerprint, detail_store
+    except ImportError as e:  # pragma: no cover — partial checkout
+        _log.debug("distributed: detail store unavailable (%s)", e)
+        return None, None
+    store = detail_store()
+    if store is None:
+        return None, None
+    return store, lambda enc: detail_fingerprint(model, algorithm, enc)
+
+
 def run_sharded(encs: Sequence, check_local: Callable[[list], List[dict]],
-                granularity: Optional[int] = None) -> List[dict]:
+                granularity: Optional[int] = None, model=None,
+                algorithm: str = "auto") -> List[dict]:
     """The distributed wavefront driver: check only this process's row
     shard through `check_local` (the ordinary single-process pass —
     chunked wavefront, escalation ladder, everything), then exchange
     per-row verdict codes so every process returns the FULL batch's
     results in submission order. Local rows carry their full result
-    dicts; remote rows carry `_remote_result` stubs.
+    dicts; remote rows carry `_remote_result` stubs — unless a shared
+    result store is configured (`model` given + JGRAFT_RESULT_STORE /
+    the cluster dir), in which case each process publishes its local
+    rows' full details before the verdict exchange and reads the
+    owners' details for remote rows after it (ISSUE 11 tentpole (d):
+    witnesses and minimized counterexamples follow the verdict). The
+    exchange's barriers order every publish before every read, so a
+    shared filesystem needs no extra synchronization; a missing or
+    degraded detail record degrades that row to the PR 7 stub, never
+    to an error.
 
     SPMD contract: every process must call with the same batch (same
     row count, same order) — the bench and the `check` CLI satisfy it
@@ -392,6 +423,12 @@ def run_sharded(encs: Sequence, check_local: Callable[[list], List[dict]],
     g = placement_granularity() if granularity is None else granularity
     lo, hi = shard_bounds(len(encs), n, pid, granularity=g)
     local = check_local(list(encs[lo:hi]))
+    store, key_fn = _detail_exchange(model, algorithm)
+    if store is not None:
+        for enc, res in zip(encs[lo:hi], local):
+            if isinstance(res, dict) and "valid?" in res:
+                # degraded rows are refused by the store's own gate
+                store.put_detail(key_fn(enc), res)
     codes = exchange_i64([_verdict_code(r) for r in local])
     results: List[dict] = []
     for p in range(n):
@@ -404,7 +441,18 @@ def run_sharded(encs: Sequence, check_local: Callable[[list], List[dict]],
                     f"shard {p} exchanged {len(codes[p])} verdicts for "
                     f"{phi - plo} rows — processes disagree on the batch "
                     "(the SPMD contract of run_sharded is broken)")
-            results.extend(_remote_result(int(c), p) for c in codes[p])
+            for row, c in zip(range(plo, phi), codes[p]):
+                stub = _remote_result(int(c), p)
+                if store is not None:
+                    detail = store.get_detail(key_fn(encs[row]))
+                    if detail is not None \
+                            and detail.get("valid?") == stub["valid?"]:
+                        # the full verdict rode the store; keep the
+                        # owner attribution on top of it
+                        detail["process"] = p
+                        detail["detail-source"] = "result-store"
+                        stub = detail
+                results.append(stub)
     return results
 
 

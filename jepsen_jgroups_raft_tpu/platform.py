@@ -64,6 +64,27 @@ def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
     return val
 
 
+def env_float(name: str, default: float,
+              minimum: Optional[float] = None) -> float:
+    """`env_int`'s float twin (lease TTLs and skew margins are
+    sub-second in tests): same defensive stance — garbage warns and
+    keeps the default, sub-minimum clamps with a warning."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = float(raw.strip())
+    except ValueError:
+        _log.warning("%s=%r is not a number; using default %g",
+                     name, raw, default)
+        return default
+    if minimum is not None and val < minimum:
+        _log.warning("%s=%g below minimum %g; clamping",
+                     name, val, minimum)
+        return minimum
+    return val
+
+
 def pin_cpu(n_devices: int = 8) -> None:
     """Force JAX onto a virtual `n_devices`-device CPU platform.
 

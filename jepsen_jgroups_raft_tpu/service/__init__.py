@@ -20,13 +20,23 @@ macro compaction) across many independent submissions:
                  poison-batch quarantine + hung-batch watchdog, stats,
                  store/ trace records.
 * http.py      — stdlib HTTP+JSON surface (`serve-checker` CLI).
-* client.py    — tenant-side client with idempotent retry/backoff
-                 (tests, bench --service, scripts/chaos_graftd.py).
+* client.py    — tenant-side client with idempotent retry/backoff and
+                 cluster routing (affinity-first, least-loaded
+                 fallback, cluster-global attempt cap).
+* store.py     — shared content-addressed result store (ISSUE 11):
+                 fingerprint → verdict entries any replica reads and
+                 writes atomically; per-row detail records for the
+                 distributed wavefront's witness exchange.
+* cluster.py   — replica membership leases, load shedding with the
+                 cluster's best retry-after, and cross-replica journal
+                 handoff (claim-by-rename, replay, re-own).
 """
 
 from .admission import QueueFull, ServiceStopped  # noqa: F401
 from .client import ServiceClient, ServiceError  # noqa: F401
+from .cluster import ClusterManager, discover_replica_urls  # noqa: F401
 from .daemon import CheckingService  # noqa: F401
 from .http import make_server, serve_checker, serve_in_thread  # noqa: F401
 from .journal import AdmissionJournal, journal_enabled  # noqa: F401
 from .request import CheckRequest  # noqa: F401
+from .store import ResultStore  # noqa: F401

@@ -16,15 +16,35 @@ request may or may not have been journaled — resubmitting is safe
 either way, which is the whole point of idempotency). Backoff is capped
 exponential with full jitter and a max-attempts cap; callers that want
 the old single-shot behavior pass ``max_attempts=1``.
+
+Cluster routing (ISSUE 11): pass ``replicas=[url…]`` and the client
+routes across the fleet — affinity-first (rendezvous hash over the
+submission payload, so identical resubmissions land on the replica
+whose caches already hold the verdict), least-loaded fallback (a
+replica that refused or failed is deprioritized until its advertised
+retry-after elapses), and failover retry that is safe because
+submission is idempotent and verdicts live in the shared store. Two
+rules are deliberately CLUSTER-GLOBAL, not per replica: ``max_attempts``
+caps the total tries across all replicas (N replicas must not multiply
+the retry budget into a fleet-wide storm), and a Retry-After is a floor
+across replicas (a shedding replica answers with the CLUSTER's best
+hint, so hopping to the next replica before it elapses just burns an
+attempt on the same full cluster). A dead replica's connection error,
+by contrast, fails over to the next replica immediately — liveness
+probing is not load backoff. ``/result`` fails over on 404 too: after a
+journal handoff the request lives on the surviving replica that
+adopted it.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import random
 import time
+from collections import OrderedDict
 from http.client import HTTPConnection, HTTPException
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 #: Connection-level failures safe to retry once submission is
 #: idempotent (refused/reset/timeout — the daemon-restart signatures).
@@ -69,25 +89,99 @@ def backoff_delay(attempt: int, base_s: float, cap_s: float,
     return delay
 
 
+def _netloc(url: str) -> str:
+    if "://" in url:
+        url = url.split("://", 1)[1]
+    return url.rstrip("/")
+
+
 class ServiceClient:
     def __init__(self, base_url: str, timeout: float = 30.0,
                  max_attempts: int = 4, backoff_base_s: float = 0.1,
                  backoff_cap_s: float = 5.0,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 replicas: Optional[Sequence[str]] = None):
         # base_url: http://host:port (path prefixes unsupported — the
-        # daemon serves at the root, like core/serve.py).
-        if "://" in base_url:
-            base_url = base_url.split("://", 1)[1]
-        self.netloc = base_url.rstrip("/")
+        # daemon serves at the root, like core/serve.py). `replicas`
+        # (ISSUE 11) adds the rest of the cluster; base_url's replica
+        # is included automatically and single-URL behavior is
+        # byte-for-byte unchanged when it is omitted.
+        self.netloc = _netloc(base_url)
+        self.netlocs: List[str] = [self.netloc]
+        for u in replicas or ():
+            n = _netloc(u)
+            if n not in self.netlocs:
+                self.netlocs.append(n)
         self.timeout = timeout
         self.max_attempts = max(1, int(max_attempts))
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self._rng = rng or random.Random()
+        #: wall time before which each replica is deprioritized in the
+        #: fallback order (stamped from its Retry-After / failures) —
+        #: the client-side half of least-loaded routing.
+        self._penalty_until: dict = {}
+        #: cluster-wide Retry-After floor (module docstring).
+        self._floor_until = 0.0
+        #: connection-level failovers performed (a replica died and the
+        #: call moved on) — the bench's failover-latency evidence.
+        self.failovers = 0
+        #: request id → the replica that answered for it (bounded):
+        #: result/cancel polls go straight to the owner instead of
+        #: walking 404 probes across the fleet on every poll. A stale
+        #: or lost hint only costs probes, never correctness.
+        self._owner: "OrderedDict[str, str]" = OrderedDict()
+        #: netloc that served the most recent successful _call (feeds
+        #: the owner map; best-effort under concurrent use).
+        self._answered_by: Optional[str] = None
+
+    # ------------------------------------------------------- routing
+
+    def _route(self, affinity: Optional[str] = None,
+               prefer: Optional[str] = None) -> List[str]:
+        """Replica order for one logical call: `prefer` (the known
+        owner of the id being polled) first when given, else the affine
+        replica (rendezvous hash — stable per payload, uniform across
+        fingerprints), then the rest least-loaded-first (soonest
+        penalty expiry; ties keep the configured order)."""
+        if len(self.netlocs) == 1:
+            return list(self.netlocs)
+        if affinity:
+            ordered = sorted(
+                self.netlocs,
+                key=lambda n: hashlib.sha256(
+                    f"{affinity}|{n}".encode()).hexdigest(),
+                reverse=True)
+        else:
+            ordered = list(self.netlocs)
+        now = time.monotonic()
+        head, tail = ordered[:1], ordered[1:]
+        tail.sort(key=lambda n: max(0.0,
+                                    self._penalty_until.get(n, 0.0) - now))
+        route = head + tail
+        if prefer in self.netlocs and route[0] != prefer:
+            route.remove(prefer)
+            route.insert(0, prefer)
+        return route
+
+    def _remember_owner(self, request_id: Optional[str]) -> None:
+        if not request_id or self._answered_by is None \
+                or len(self.netlocs) == 1:
+            return
+        self._owner[request_id] = self._answered_by
+        self._owner.move_to_end(request_id)
+        while len(self._owner) > 1024:
+            self._owner.popitem(last=False)
+
+    def _penalize(self, netloc: str, for_s: float) -> None:
+        self._penalty_until[netloc] = max(
+            self._penalty_until.get(netloc, 0.0),
+            time.monotonic() + max(0.1, for_s))
 
     def _call_once(self, method: str, path: str,
-                   body: Optional[dict] = None) -> dict:
-        conn = HTTPConnection(self.netloc, timeout=self.timeout)
+                   body: Optional[dict] = None,
+                   netloc: Optional[str] = None) -> dict:
+        conn = HTTPConnection(netloc or self.netloc, timeout=self.timeout)
         try:
             payload = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if payload else {}
@@ -101,33 +195,76 @@ class ServiceClient:
         return data
 
     def _call(self, method: str, path: str, body: Optional[dict] = None,
-              retry: bool = True) -> dict:
+              retry: bool = True, affinity: Optional[str] = None,
+              failover_404: bool = False,
+              prefer: Optional[str] = None) -> dict:
         """One logical call with the retry discipline (module
         docstring). `retry=False` restores single-shot semantics for
-        calls the caller wants to fail fast."""
+        calls the caller wants to fail fast. The attempt cap is
+        CLUSTER-GLOBAL: every try, on whichever replica, counts against
+        the same `max_attempts` budget — failover must not multiply
+        the retry storm by the replica count (ISSUE 11 satellite)."""
+        route = self._route(affinity, prefer=prefer)
         attempts = self.max_attempts if retry else 1
         last: Exception = None
-        for attempt in range(1, attempts + 1):
+        ri = 0
+        seen_404 = 0
+        attempt = 0
+        while attempt < attempts:
+            attempt += 1
+            netloc = route[ri % len(route)]
             try:
-                return self._call_once(method, path, body)
+                out = self._call_once(method, path, body, netloc=netloc)
+                self._penalty_until.pop(netloc, None)
+                self._answered_by = netloc
+                return out
             except ServiceError as e:
-                if e.status not in RETRYABLE_STATUSES or attempt == attempts:
+                if e.status == 404 and failover_404 \
+                        and seen_404 < len(route) - 1:
+                    # the request may live on the replica that adopted
+                    # a dead peer's journal: probe the rest of the
+                    # fleet before concluding "unknown id". Probes are
+                    # sequential reads, not retries — they do not
+                    # consume the attempt budget.
+                    attempt -= 1
+                    seen_404 += 1
+                    ri += 1
+                    continue
+                if e.status not in RETRYABLE_STATUSES \
+                        or attempt >= attempts:
                     raise
                 last = e
+                if e.retry_after_s is not None:
+                    # the daemon's hint is already the CLUSTER's best
+                    # (its 429 consults peer leases): floor every
+                    # replica behind it, not just the one that answered
+                    self._floor_until = max(
+                        self._floor_until,
+                        time.monotonic() + e.retry_after_s)
+                    self._penalize(netloc, e.retry_after_s)
                 delay = backoff_delay(attempt, self.backoff_base_s,
                                       self.backoff_cap_s,
                                       retry_after_s=e.retry_after_s,
                                       rng=self._rng)
+                delay = max(delay, self._floor_until - time.monotonic())
+                ri += 1
             except RETRYABLE_CONN_ERRORS as e:
                 # Safe because /submit is idempotent (fingerprint
                 # attach / cache hit) and every other endpoint is a
                 # read or an idempotent cancel.
-                if attempt == attempts:
+                if attempt >= attempts:
                     raise
                 last = e
+                self._penalize(netloc, 1.0)
+                ri += 1
+                if len(route) > 1 and attempt < len(route):
+                    # a dead replica is a liveness event, not load:
+                    # fail over to the next replica immediately
+                    self.failovers += 1
+                    continue
                 delay = backoff_delay(attempt, self.backoff_base_s,
                                       self.backoff_cap_s, rng=self._rng)
-            time.sleep(delay)
+            time.sleep(max(0.0, delay))
         raise last  # unreachable; loop always returns or raises
 
     # ------------------------------------------------------- surface
@@ -135,7 +272,8 @@ class ServiceClient:
     def submit(self, histories: Sequence, workload: str = "register",
                algorithm: str = "auto", deadline_ms: Optional[float] = None,
                priority: int = 0, retry: bool = True,
-               consistency: str = "linearizable") -> dict:
+               consistency: str = "linearizable",
+               affinity: bool = True) -> dict:
         """Submit histories (History objects or op-dict lists); returns
         the daemon's request record ({"id", "status", ...}). Retries
         429/503/connection failures with capped jittered backoff up to
@@ -146,11 +284,26 @@ class ServiceClient:
         session)."""
         rows = [h.to_dicts() if hasattr(h, "to_dicts") else list(h)
                 for h in histories]
-        return self._call("POST", "/submit", {
+        key = None
+        if affinity and len(self.netlocs) > 1:
+            # content-keyed affinity (ISSUE 11): identical payloads
+            # route to the same replica, so idempotent resubmissions
+            # attach/cache-hit there instead of fanning one fingerprint
+            # across the fleet. Scheduling metadata (deadline,
+            # priority) stays out of the key — it does not change the
+            # verdict identity. `affinity=False` keeps the configured
+            # replica order (the bench's failover phase pins the dead
+            # replica at the head this way).
+            key = hashlib.sha256(json.dumps(
+                [workload, algorithm, consistency, rows],
+                sort_keys=True, default=str).encode()).hexdigest()
+        rec = self._call("POST", "/submit", {
             "workload": workload, "histories": rows,
             "algorithm": algorithm, "deadline_ms": deadline_ms,
             "priority": priority, "consistency": consistency},
-            retry=retry)
+            retry=retry, affinity=key)
+        self._remember_owner(rec.get("id"))
+        return rec
 
     def submit_run_dir(self, run_dir: str, workload: Optional[str] = None,
                        algorithm: str = "auto", retry: bool = True,
@@ -165,10 +318,20 @@ class ServiceClient:
         path = f"/result?id={request_id}"
         if wait_s is not None:
             path += f"&wait_s={wait_s}"
-        return self._call("GET", path)
+        # The known owner (the replica that answered the submit or the
+        # last poll) leads the route — polling must not walk 404
+        # probes across the fleet on every call. 404 still fails over:
+        # after a journal handoff the id answers from the survivor
+        # that adopted it (ISSUE 11), and the owner map re-learns it.
+        rec = self._call("GET", path,
+                         failover_404=len(self.netlocs) > 1,
+                         prefer=self._owner.get(request_id))
+        self._remember_owner(request_id)
+        return rec
 
     def cancel(self, request_id: str) -> dict:
-        return self._call("POST", "/cancel", {"id": request_id})
+        return self._call("POST", "/cancel", {"id": request_id},
+                          prefer=self._owner.get(request_id))
 
     def stats(self) -> dict:
         return self._call("GET", "/stats")

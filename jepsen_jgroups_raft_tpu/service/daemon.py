@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import statistics
 import threading
 import time
@@ -160,6 +161,10 @@ class CheckingService:
                  journal_dir: Optional[str] = None,
                  crash_cap: Optional[int] = None,
                  watchdog_margin_s: Optional[float] = None,
+                 cluster_dir: Optional[str] = None,
+                 replica_id: Optional[str] = None,
+                 advertise_url: Optional[str] = None,
+                 lease_ttl_s: Optional[float] = None,
                  autostart: bool = True):
         self.name = name
         self.store_root = Path(store_root) if store_root else None
@@ -204,11 +209,6 @@ class CheckingService:
         #: primary id → attached idempotent-duplicate followers.
         self._primary_by_fp: dict = {}
         self._followers: dict = {}
-        self._journal: Optional[AdmissionJournal] = None
-        if journal_enabled() and (journal_dir or self.store_root):
-            root = (Path(journal_dir) if journal_dir
-                    else self.store_root / self.name / "journal")
-            self._journal = AdmissionJournal(root, retain=self._retain)
         self._stats = {
             "submitted": 0, "completed": 0, "failed": 0, "cancelled": 0,
             "rejected": 0, "cache_hits": 0, "batches": 0, "batch_rows": 0,
@@ -216,14 +216,87 @@ class CheckingService:
             "max_queue_depth": 0, "worker_restarts": 0, "trace_errors": 0,
             "recovered_requests": 0, "attached_requests": 0,
             "quarantined": 0, "watchdog_requeues": 0,
+            # cluster tier (ISSUE 11) — always in the schema, zero when
+            # clustering is not configured (the seam stays inert)
+            "store_hits": 0, "store_puts": 0,
+            "handoff_claims": 0, "handoff_requests": 0,
         }
         self._service_time_s = 1.0  # EWMA of per-request service time
+        # Cluster tier (ISSUE 11): constructed only when a cluster dir
+        # is configured — the single-replica daemon never imports the
+        # module. Created BEFORE the journal (the shared layout owns
+        # the WAL path) and before _recover (the manager's first lease
+        # re-arms liveness before the boot-time replay window, so a
+        # restarting replica's peers do not claim the WAL it is
+        # replaying).
+        self.cluster = None
+        cdir = (cluster_dir if cluster_dir is not None else
+                os.environ.get("JGRAFT_SERVICE_CLUSTER_DIR", "").strip()
+                or None)
+        if cdir:
+            from .cluster import ClusterManager
+
+            rid = (replica_id
+                   or os.environ.get("JGRAFT_SERVICE_REPLICA_ID",
+                                     "").strip()
+                   or f"{self.name}-{os.getpid()}")
+            url = (advertise_url
+                   or os.environ.get("JGRAFT_SERVICE_ADVERTISE_URL",
+                                     "").strip() or None)
+            self.cluster = ClusterManager(self, cdir, rid, url=url,
+                                          lease_ttl=lease_ttl_s,
+                                          autostart=autostart)
+        self._journal: Optional[AdmissionJournal] = None
+        if journal_enabled() and (journal_dir or self.cluster is not None
+                                  or self.store_root):
+            root = (Path(journal_dir) if journal_dir
+                    else self.cluster.journal_dir()
+                    if self.cluster is not None
+                    else self.store_root / self.name / "journal")
+            if self.cluster is not None and not journal_dir \
+                    and self.store_root is not None:
+                self._migrate_legacy_journal(root)
+            self._journal = AdmissionJournal(root, retain=self._retain)
         if self._journal is not None:
             self._recover()
         if autostart:
             self.start()
 
     # ------------------------------------------------------- recovery
+
+    def _migrate_legacy_journal(self, root: Path) -> None:
+        """First boot after clustering is enabled on a daemon that was
+        running durable single-replica: the PR 8 per-daemon WAL
+        (store/<name>/journal/wal.jsonl) is moved into the shared
+        layout so its accepted-but-unfinished entries replay instead of
+        being silently abandoned at the legacy path. When BOTH WALs
+        exist (a partial earlier migration or manual copy) the cluster
+        one wins and the legacy one is reported loudly — guessing at a
+        record-level merge could double-admit."""
+        import shutil
+
+        legacy = self.store_root / self.name / "journal" / "wal.jsonl"
+        target = root / "wal.jsonl"
+        if not legacy.exists():
+            return
+        if target.exists():
+            LOG.warning("%s: legacy journal %s left in place (a WAL "
+                        "already exists at %s); entries there will NOT "
+                        "replay — inspect and remove it manually",
+                        self.name, legacy, target)
+            return
+        try:
+            root.mkdir(parents=True, exist_ok=True)
+            # shutil.move survives a cross-filesystem store/cluster
+            # split, where os.replace would EXDEV
+            shutil.move(str(legacy), str(target))
+            LOG.warning("%s: migrated legacy journal %s into the "
+                        "cluster layout at %s", self.name, legacy,
+                        target)
+        except OSError:
+            LOG.warning("%s: legacy journal migration failed; entries "
+                        "at %s will not replay", self.name, legacy,
+                        exc_info=True)
 
     def _recover(self) -> None:
         """Crash recovery (ISSUE 8): replay the admission journal.
@@ -265,12 +338,27 @@ class CheckingService:
             if status == DONE and results is not None \
                     and len(results) == req.n_rows:
                 self.cache.put(req.fingerprint, results)
+                # lift the WAL terminal record into the shared store
+                # (ISSUE 11): a verdict this replica computed before
+                # the restart becomes a fleet-wide cache hit
+                if self.cluster is not None and \
+                        self.cluster.store.put(req.fingerprint, results):
+                    self._count("store_puts")
         recovered = []
         for req in replayed["unfinished"]:
             req._journaled = True
             with self._lock:
                 self._requests[req.id] = req
             cached = self.cache.get(req.fingerprint)
+            if cached is None and self.cluster is not None:
+                # another replica may have verified this fingerprint
+                # while we were down — a cold-started replica warms
+                # from the store instead of re-checking (ISSUE 11)
+                stored = self.cluster.store.get(req.fingerprint)
+                if stored is not None and len(stored) == req.n_rows:
+                    cached = stored
+                    self.cache.put(req.fingerprint, stored)
+                    self._count("store_hits")
             if cached is not None and len(cached) == req.n_rows:
                 req.cached = True
                 req.finish(DONE, results=cached)
@@ -305,6 +393,72 @@ class CheckingService:
                      "skipped", self.name, len(recovered),
                      len(replayed["finished"]), replayed["skipped"])
 
+    def adopt_requests(self, reqs, origin: str = "") -> int:
+        """Re-own an expired replica's unfinished journal entries
+        (ISSUE 11 tentpole (c); called by ClusterManager._adopt after
+        its atomic rename claim). Each adopted request is re-journaled
+        into THIS replica's WAL before it becomes runnable — the
+        durability chain has no gap: until the claimed dir is removed
+        the entry exists there, and from the append here it exists in
+        our WAL under our live lease. Dedup mirrors _recover: a
+        fingerprint the caches or a live primary already cover
+        short-circuits instead of re-executing (resubmit-at-most-once,
+        cluster-wide)."""
+        taken = 0
+        recovered = []
+        for req in reqs:
+            if self._stop.is_set():
+                # shutdown mid-adoption: entries not taken stay in the
+                # claimed dir (the manager skips its cleanup when we
+                # report a partial take), so nothing is orphaned
+                break
+            req.replayed = True
+            if self._journal is not None:
+                req._journaled = True
+            with self._lock:
+                self._requests[req.id] = req
+            if self._journal is not None:
+                self._journal.append_submit(req)
+            self._count("handoff_requests")
+            taken += 1
+            cached = self.cache.get(req.fingerprint)
+            if cached is None and self.cluster is not None:
+                stored = self.cluster.store.get(req.fingerprint)
+                if stored is not None and len(stored) == req.n_rows:
+                    cached = stored
+                    self.cache.put(req.fingerprint, stored)
+                    self._count("store_hits")
+            if cached is not None and len(cached) == req.n_rows:
+                req.cached = True
+                req.finish(DONE, results=cached)
+                self._count("completed")
+                self._retire(req)
+                self._write_trace(req)
+                continue
+            attached = False
+            with self._lock:
+                primary = self._primary_by_fp.get(req.fingerprint)
+                if primary is not None and not primary.terminal:
+                    req.attached_to = primary.id
+                    self._followers.setdefault(primary.id, []).append(req)
+                    self._stats["attached_requests"] += 1
+                    attached = True
+                else:
+                    self._primary_by_fp[req.fingerprint] = req
+            if not attached:
+                recovered.append(req)
+        if recovered:
+            # replay() delivered them deadline-sorted; requeue preserves
+            # that order at the head (adopted work was admitted before
+            # anything currently queued here)
+            self.queue.requeue(recovered)
+        if taken:
+            self._ensure_worker()
+            LOG.warning("%s adopted %d unfinished request(s) from "
+                        "expired replica %s (%d requeued)", self.name,
+                        taken, origin or "<unknown>", len(recovered))
+        return taken
+
     # ------------------------------------------------------- lifecycle
 
     def start(self) -> None:
@@ -313,6 +467,8 @@ class CheckingService:
         for q in self._shard_queues:
             q.reopen()
         self._started = True
+        if self.cluster is not None:
+            self.cluster.start()
         self._ensure_worker()
 
     def _ensure_worker(self) -> None:
@@ -354,6 +510,14 @@ class CheckingService:
         lands before the drain (and is failed by it) or gets
         ServiceStopped from `put` — never a silently-stranded entry."""
         self._stop.set()
+        # Stop the cluster agent FIRST (joins its thread): a handoff
+        # adoption racing this shutdown would otherwise requeue adopted
+        # entries after the drain below and strand them. Entries it
+        # already re-journaled are safe either way — they are in OUR
+        # WAL, so the drain's terminal markers (or a later replay)
+        # account for them.
+        if self.cluster is not None:
+            self.cluster.shutdown()
         self.queue.close()
         # Close the shard queues BEFORE joining: a dispatcher mid-route
         # either landed its batch (drained here) or gets a refused put
@@ -672,7 +836,23 @@ class CheckingService:
             self._retire(req)
             self._write_trace(req)
             return req
+        if self.cluster is not None:
+            # Shared-store lookup (ISSUE 11): a fingerprint any replica
+            # already verified completes here without a kernel launch —
+            # the cross-replica cache hit. The LRU is warmed so repeats
+            # skip the filesystem too.
+            stored = self.cluster.store.get(req.fingerprint)
+            if stored is not None and len(stored) == req.n_rows:
+                self.cache.put(req.fingerprint, stored)
+                req.cached = True
+                req.finish(DONE, results=stored)
+                self._count("submitted", "store_hits", "completed")
+                self._observe_latency(req)
+                self._retire(req)
+                self._write_trace(req)
+                return req
         retry_after = self._retry_after()
+        reject: Optional[Exception] = None
         with self._lock:
             # Idempotent resubmission (ISSUE 8): a fingerprint that is
             # already queued/running ATTACHES to the live primary
@@ -698,6 +878,13 @@ class CheckingService:
             else:
                 self._primary_by_fp[req.fingerprint] = req
                 try:
+                    if self.cluster is not None \
+                            and self.cluster.should_shed():
+                        # past the shed threshold (tentpole (b)): shed
+                        # to the cluster with its best retry-after
+                        # instead of queueing into a backlog a peer
+                        # could absorb now
+                        raise QueueFull(self.queue.depth, retry_after)
                     self.queue.put(req, retry_after_s=retry_after)
                 except (QueueFull, ServiceStopped) as e:
                     if isinstance(e, QueueFull):
@@ -705,10 +892,25 @@ class CheckingService:
                     del self._requests[req.id]
                     if self._primary_by_fp.get(req.fingerprint) is req:
                         del self._primary_by_fp[req.fingerprint]
-                    raise
-                self._stats["submitted"] += 1
-                self._stats["max_queue_depth"] = max(
-                    self._stats["max_queue_depth"], self.queue.depth)
+                    reject = e
+                else:
+                    self._stats["submitted"] += 1
+                    self._stats["max_queue_depth"] = max(
+                        self._stats["max_queue_depth"], self.queue.depth)
+        if reject is not None:
+            if isinstance(reject, QueueFull) and self.cluster is not None:
+                # A 429 from this replica carries the CLUSTER's best
+                # retry-after (min over live leases), so the backed-off
+                # client returns when the least-loaded peer has room.
+                # Consulting the lease files happens HERE — only on the
+                # reject path and outside the daemon lock — never per
+                # accepted submission (O(replicas) file reads do not
+                # belong on the admission hot path).
+                raise QueueFull(
+                    reject.depth,
+                    self.cluster.best_retry_after(
+                        reject.retry_after_s)) from None
+            raise reject
         if self._journal is not None:
             # Durability point: the WAL record is fsync'd BEFORE the
             # 202 becomes visible to the client — an accepted request
@@ -777,6 +979,9 @@ class CheckingService:
         out["journal_enabled"] = self._journal is not None
         if self._journal is not None:
             out.update(self._journal.stats())
+        out["cluster_enabled"] = self.cluster is not None
+        if self.cluster is not None:
+            out.update(self.cluster.stats())
         return out
 
     # ----------------------------------------------------- accounting
@@ -900,6 +1105,13 @@ class CheckingService:
                     # this scheduler's local degrade path. A cached
                     # stamp would replay onto a healed platform.
                     self.cache.put(r.fingerprint, r.results)
+                    # publish fleet-wide (ISSUE 11): the store applies
+                    # the same never-persist-degraded rule and is
+                    # first-wins against a racing replica
+                    if self.cluster is not None and \
+                            self.cluster.store.put(r.fingerprint,
+                                                   r.results):
+                        self._count("store_puts")
             elif r.status == CANCELLED:
                 self._count("cancelled")
             elif r.status == FAILED:

@@ -190,19 +190,33 @@ def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
                   port: int = 8091,
                   queue_capacity: Optional[int] = None,
                   batch_wait: Optional[float] = None,
-                  n_workers: Optional[int] = None) -> int:
+                  n_workers: Optional[int] = None,
+                  cluster_dir: Optional[str] = None,
+                  replica_id: Optional[str] = None) -> int:
     """CLI entry (`python -m jepsen_jgroups_raft_tpu serve-checker`):
     run graftd in the foreground until interrupted."""
     service = CheckingService(store_root=store_root,
                               queue_capacity=queue_capacity,
                               batch_wait=batch_wait,
-                              n_workers=n_workers)
+                              n_workers=n_workers,
+                              cluster_dir=cluster_dir,
+                              replica_id=replica_id)
     httpd, bound = make_server(service, host, port)
+    if service.cluster is not None and service.cluster.url is None:
+        # Late-bind the advertised URL (the ephemeral port exists only
+        # now) unless JGRAFT_SERVICE_ADVERTISE_URL pinned one; 0.0.0.0
+        # is a bind address, not a reachable one — advertise loopback
+        # for the single-host cluster recipes (docs/CI/chaos), real
+        # fleets set the env to the host's routable address.
+        reach = "127.0.0.1" if host in ("0.0.0.0", "::") else host
+        service.cluster.set_url(f"http://{reach}:{bound}")
     recovered = service.stats()["recovered_requests"]
     print(f"graftd: checking service on http://{host}:{bound}/ "
           f"(queue={service.queue.capacity}, "
           f"workers={service.n_workers}, store={store_root}, "
           f"journal={'on' if service._journal is not None else 'off'}"
+          + (f", cluster={service.cluster.replica_id}"
+             if service.cluster is not None else "")
           + (f", recovered={recovered}" if recovered else "") + ")")
     try:
         httpd.serve_forever()

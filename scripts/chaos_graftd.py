@@ -14,24 +14,38 @@ Faults injected:
   * slow + failing journal IO (fsync raising / stalling — durability
     degrades, availability must not)
   * a hung batch (wedged device-launch stand-in — the watchdog path)
+  * SIGKILL of a WHOLE REPLICA in a shared-store cluster (ISSUE 11 —
+    `--replicas N`): a surviving replica must claim and finish the
+    dead one's journal.
 
-Invariants asserted (the ISSUE-8 acceptance bar):
+Invariants asserted (the ISSUE-8 acceptance bar, held CLUSTER-WIDE by
+the ISSUE-11 replica phase):
   1. NOTHING ACCEPTED IS LOST: every request the daemon 202'd reaches a
-     terminal state, including across SIGKILL+restart.
+     terminal state, including across SIGKILL+restart — and across a
+     whole-replica kill, via cross-replica journal handoff.
   2. RECOVERED VERDICTS ARE TRUE VERDICTS: every DONE verdict equals a
      direct `check_histories` of the same history.
   3. IDEMPOTENT RESUBMISSION EXECUTES AT MOST ONCE: a duplicate
-     fingerprint attaches or cache-hits; the observed execution count
-     does not grow.
+     fingerprint attaches or cache-hits (cluster-wide: the shared
+     result store answers it on ANY replica); the observed execution
+     count does not grow.
   4. NO WEDGED QUEUES: after every fault phase the daemon still serves
      a fresh healthy submission and its queue drains.
+  5. NO ORPHANED JOURNAL ENTRY AFTER LEASE EXPIRY (cluster): once the
+     dead replica's lease expires and the handoff completes, no journal
+     dir, claim dir, or lease of the dead replica remains.
+  6. NO DOUBLE-OWNERSHIP OF A HANDED-OFF ENTRY (cluster): exactly one
+     surviving replica claims the dead WAL (claims are atomic renames).
   Plus the ablation: JGRAFT_SERVICE_JOURNAL=0 restores the in-memory
   daemon (no journal dir; a kill loses pending work — today's
-  behavior, on purpose).
+  behavior, on purpose; cluster-wide, a killed journal-less replica's
+  pending work is lost by design too).
 
 Usage:
-  python scripts/chaos_graftd.py --quick     # CI-sized (~2 min)
-  python scripts/chaos_graftd.py             # fuller soak
+  python scripts/chaos_graftd.py --quick          # CI-sized (~30 s)
+  python scripts/chaos_graftd.py                  # fuller soak
+  python scripts/chaos_graftd.py --replicas 3     # bigger cluster
+  python scripts/chaos_graftd.py --cluster-only   # replica phase only
 """
 
 from __future__ import annotations
@@ -450,6 +464,215 @@ def phase_poison_and_hang(rng: random.Random) -> None:
         svc.shutdown(wait=True)
 
 
+# --------------------------------------- phase 5: whole-replica SIGKILL
+
+
+def spawn_replica(cdir: str, store: str, rid: str, extra_env: dict):
+    """One cluster member: a serve-checker subprocess registered in the
+    shared cluster dir with a fast lease (ttl 1 s, skew 0.2 s, so a
+    kill hands off within a couple of seconds)."""
+    env = {
+        "JGRAFT_SERVICE_CLUSTER_DIR": cdir,
+        "JGRAFT_SERVICE_REPLICA_ID": rid,
+        "JGRAFT_CLUSTER_TTL_S": "1.0",
+        "JGRAFT_CLUSTER_SKEW_S": "0.2",
+        **extra_env,
+    }
+    return spawn_daemon(store, env)
+
+
+def await_cluster_terminal(client, request_id: str,
+                           timeout_s: float) -> dict:
+    """await_terminal that tolerates the handoff window: between the
+    kill and the survivor's adoption the id answers 404 on every
+    replica — keep polling until the claim lands or the deadline."""
+    from jepsen_jgroups_raft_tpu.service import ServiceError
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            rec = client.result(request_id, wait_s=10.0)
+            if rec["status"] in ("done", "failed", "cancelled") \
+                    or time.monotonic() > deadline:
+                return rec
+        except ServiceError as e:
+            if e.status != 404 or time.monotonic() > deadline:
+                raise
+            time.sleep(0.25)
+
+
+def phase_cluster(n_requests: int, rng: random.Random,
+                  n_replicas: int) -> None:
+    print(f"phase 5: clustered graftd — whole-replica SIGKILL "
+          f"({n_replicas} replicas, shared store + journal handoff)")
+    n = max(2, min(n_requests, 8))
+    pairs = make_histories(rng, n)
+    want = direct_verdicts(pairs)
+    with tempfile.TemporaryDirectory(prefix="chaos-graftd-cluster-") \
+            as cdir:
+        _phase_cluster(cdir, pairs, want, rng, n_replicas)
+    _phase_cluster_ablation(rng)
+
+
+def _phase_cluster(cdir, pairs, want, rng, n_replicas: int) -> None:
+    from jepsen_jgroups_raft_tpu.service import ServiceClient
+
+    procs, clients = [], []
+    try:
+        # replica 0 is the victim: a huge batch-formation linger keeps
+        # every accepted request pending when the kill lands (the worst
+        # case for the handoff)
+        for k in range(n_replicas):
+            extra = ({"JGRAFT_SERVICE_BATCH_WAIT_MS": "30000"}
+                     if k == 0 else {})
+            p, c = spawn_replica(cdir, os.path.join(cdir, f"store-r{k}"),
+                                 f"r{k}", extra)
+            procs.append(p)
+            clients.append(c)
+        urls = [f"http://{c.netloc}" for c in clients]
+        survivors = clients[1:]
+        fleet = ServiceClient(urls[1], replicas=urls[2:] + [urls[0]],
+                              max_attempts=6, backoff_base_s=0.2,
+                              backoff_cap_s=1.0, timeout=120.0)
+
+        # cross-replica store hit BEFORE the kill: a fingerprint first
+        # checked on replica 1 must answer from replica 0 (the lingerer
+        # — only a launch-free admission-time hit returns done there)
+        first = survivors[0].submit([pairs[0][0]], workload="register")
+        out = await_terminal(survivors[0], first["id"], 300)
+        check(out["status"] == "done" and out.get("valid?") == want[0],
+              "cluster store: replica 1 verified the seed fingerprint")
+        b0 = clients[0].stats()["batches"]
+        xrep = clients[0].submit([pairs[0][0]], workload="register")
+        check(xrep.get("cached") is True
+              and clients[0].stats()["batches"] == b0
+              and clients[0].stats()["store_hits"] >= 1,
+              "invariant 3 cluster-wide: replica 0 answered replica 1's "
+              "fingerprint from the shared store without a kernel launch")
+
+        # pending load on the victim, plus an idempotent duplicate
+        recs = [clients[0].submit([h], workload="register")
+                for h, _ in pairs[1:]]
+        dup = clients[0].submit([pairs[1][0]], workload="register")
+        check(all(r["status"] == "queued" for r in recs)
+              and dup.get("attached_to"),
+              f"{len(recs)} pending + 1 attached duplicate accepted on "
+              "the victim replica")
+
+        os.kill(procs[0].pid, signal.SIGKILL)  # lint: allow(unhealed)
+        procs[0].wait(30)  # heal = the surviving replicas' handoff
+        print("  ... replica r0 SIGKILL'd; awaiting lease expiry + "
+              "journal handoff")
+
+        outs = [await_cluster_terminal(fleet, r["id"], 120) for r in recs]
+        check(all(o["status"] == "done" for o in outs),
+              "invariant 1 cluster-wide: every request accepted by the "
+              "dead replica reached a terminal state on a survivor "
+              f"({[o['status'] for o in outs].count('done')}/{len(outs)} "
+              "done)")
+        got = [o.get("valid?") for o in outs]
+        check(got == want[1:],
+              "invariant 2 cluster-wide: handed-off verdicts identical "
+              "to direct check_histories")
+        dup_out = await_cluster_terminal(fleet, dup["id"], 120)
+        check(dup_out["status"] == "done"
+              and dup_out.get("valid?") == want[1],
+              "the attached duplicate reached the same verdict via the "
+              "handoff")
+
+        stats = [c.stats() for c in survivors]
+        claims = sum(s["handoff_claims"] for s in stats)
+        check(claims == 1,
+              "invariant 6: exactly one survivor claimed the dead WAL "
+              f"(claims per survivor: {[s['handoff_claims'] for s in stats]})")
+        handed = sum(s["handoff_requests"] for s in stats)
+        check(handed == len(recs) + 1,
+              f"all {len(recs) + 1} journaled entries were re-owned "
+              f"(handoff_requests={handed})")
+
+        # invariant 5: nothing orphaned once the handoff completed
+        jroot = Path(cdir) / "journal"
+        live_dirs = sorted(p.name for p in jroot.iterdir() if p.is_dir())
+        check("r0" not in live_dirs
+              and not any(".claim." in d for d in live_dirs),
+              f"invariant 5: no orphaned journal/claim dir for the dead "
+              f"replica (journal dirs: {live_dirs})")
+        leases = sorted(p.name for p in (Path(cdir) / "leases").glob("*"))
+        check("r0.json" not in leases,
+              f"invariant 5: dead replica's lease reaped (leases: "
+              f"{leases})")
+
+        # invariant 3 again, across the kill: a payload the dead
+        # replica completed via handoff must now be a store hit
+        s0 = survivors[0].stats()
+        resub = survivors[0].submit([pairs[1][0]], workload="register")
+        s1 = survivors[0].stats()
+        check(resub.get("cached") is True and s1["batches"] == s0["batches"],
+              "invariant 3: post-kill resubmission is a cluster store/"
+              "cache hit, no new batch")
+
+        # invariant 4: every survivor still serves fresh work
+        for i, c in enumerate(survivors):
+            fresh = c.submit([make_histories(rng, 1)[0][0]],
+                             workload="register")
+            o = await_terminal(c, fresh["id"], 300)
+            check(o["status"] == "done",
+                  f"invariant 4: survivor r{i + 1} serves fresh work "
+                  "after the kill")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()  # lint: allow(unhealed) — phase over
+                p.wait(30)
+
+
+def _phase_cluster_ablation(rng: random.Random) -> None:
+    """JGRAFT_SERVICE_JOURNAL=0 across the cluster: a killed replica's
+    pending work is LOST (no WAL to hand off) — by design; the
+    survivors must stay healthy and claim nothing."""
+    print("  ... cluster ablation: JGRAFT_SERVICE_JOURNAL=0 "
+          "(kill loses the victim's pending work — by design)")
+    from jepsen_jgroups_raft_tpu.service import ServiceError
+
+    pairs = make_histories(rng, 2)
+    with tempfile.TemporaryDirectory(
+            prefix="chaos-graftd-cluster-nojournal-") as cdir:
+        pv, cv = spawn_replica(cdir, os.path.join(cdir, "store-r0"), "r0",
+                               {"JGRAFT_SERVICE_JOURNAL": "0",
+                                "JGRAFT_SERVICE_BATCH_WAIT_MS": "30000"})
+        ps, cs = spawn_replica(cdir, os.path.join(cdir, "store-r1"), "r1",
+                               {"JGRAFT_SERVICE_JOURNAL": "0"})
+        try:
+            recs = [cv.submit([h], workload="register") for h, _ in pairs]
+            os.kill(pv.pid, signal.SIGKILL)  # lint: allow(unhealed)
+            pv.wait(30)
+            time.sleep(3.0)  # lease expiry (1.2 s) + a scan period
+            lost = 0
+            for r in recs:
+                try:
+                    cs.result(r["id"])
+                except ServiceError as e:
+                    if e.status == 404:
+                        lost += 1
+            st = cs.stats()
+            check(lost == len(recs) and st["handoff_claims"] == 0,
+                  "ablation: journal-less victim's pending work lost, "
+                  "survivor claimed nothing — losing work only where "
+                  "designed")
+            check(not (Path(cdir) / "journal" / "r0").exists(),
+                  "ablation: no journal dir for the journal-less victim")
+            fresh = cs.submit([make_histories(rng, 1)[0][0]],
+                              workload="register")
+            out = await_terminal(cs, fresh["id"], 300)
+            check(out["status"] == "done",
+                  "ablation: survivor still serves fresh work")
+        finally:
+            for p in (pv, ps):
+                if p.poll() is None:
+                    p.kill()  # lint: allow(unhealed) — phase over
+                    p.wait(30)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true",
@@ -459,21 +682,36 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=20260804)
     ap.add_argument("--skip-subprocess", action="store_true",
                     help="skip the SIGKILL phases (in-process only)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="replica count for the cluster phase "
+                         "(default 2; 0/1 skips it)")
+    ap.add_argument("--cluster-only", action="store_true",
+                    help="run only the cluster phase (the CI cluster "
+                         "smoke stage)")
     args = ap.parse_args()
     n = args.requests or (8 if args.quick else 32)
     rng = random.Random(args.seed)
+    n_replicas = args.replicas if args.replicas is not None else 2
 
     pin_cpu(8)
     t0 = time.monotonic()
-    if not args.skip_subprocess:
-        phase_sigkill(n, rng)
-        phase_journal_off(rng)
-    phase_fault_storm(n, rng)
-    phase_poison_and_hang(rng)
+    if args.cluster_only:
+        phase_cluster(n, rng, max(2, n_replicas))
+    else:
+        if not args.skip_subprocess:
+            phase_sigkill(n, rng)
+            phase_journal_off(rng)
+        phase_fault_storm(n, rng)
+        phase_poison_and_hang(rng)
+        if n_replicas >= 2 and not args.skip_subprocess:
+            phase_cluster(n, rng, n_replicas)
 
     wall = time.monotonic() - t0
     print(json.dumps({"chaos_graftd": "fail" if FAILURES else "pass",
                       "failures": FAILURES, "requests_per_phase": n,
+                      "replicas": (max(2, n_replicas) if args.cluster_only
+                                   else n_replicas
+                                   if not args.skip_subprocess else 0),
                       "wall_s": round(wall, 1)}))
     return 1 if FAILURES else 0
 

@@ -79,6 +79,17 @@ class TestSelfHosting:
             "jepsen_jgroups_raft_tpu/service/journal.py")
         assert taxonomy.applies_to("scripts/chaos_graftd.py")
 
+    def test_taxonomy_scope_covers_cluster_tier(self):
+        # ISSUE-11 satellite: the shared result store and the
+        # membership/handoff agent ride the service/ prefix — a
+        # silently-swallowed store or lease IO failure would hide
+        # exactly the cross-replica corruption the chaos invariants
+        # exist to catch (and the shipped baseline stays EMPTY, so
+        # both files must be clean, not baselined).
+        for rel in ("service/store.py", "service/cluster.py"):
+            assert taxonomy.applies_to(
+                f"jepsen_jgroups_raft_tpu/{rel}"), rel
+
     def test_serve_verdict_broad_except_would_fire(self):
         # the pre-fix _verdict shape (bare `except Exception: return
         # None`) is exactly a silent swallow; the fixed narrow catch

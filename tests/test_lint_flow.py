@@ -679,6 +679,18 @@ class TestDistributedTierResourceScope:
             src = SourceFile.load(path)
             assert resource.analyze_source(src) == [], str(path)
 
+    def test_scope_covers_cluster_tier(self):
+        # ISSUE-11 satellite: the result store publishes via temp
+        # files + os.replace and the cluster manager holds lease and
+        # claimed-journal handles — a leaked temp or handle on an
+        # exception path would accrete forever in a shared dir every
+        # replica scans. Both ride the service/ prefix and must be
+        # CLEAN (shipped baseline stays empty).
+        for f in ("service/store.py", "service/cluster.py"):
+            assert resource.applies_to(f"jepsen_jgroups_raft_tpu/{f}"), f
+            src = SourceFile.load(PKG / Path(f))
+            assert resource.analyze_source(src) == [], f
+
     def test_launcher_unkilled_popen_shape_fires(self):
         # launch_local_cluster adopts every child into `procs` inside
         # a try whose finally kills survivors; a bare spawn whose
