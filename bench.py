@@ -901,6 +901,9 @@ def run_service(platform_note: str) -> None:
                                                  journal_enabled,
                                                  serve_in_thread)
 
+    if "--stream" in sys.argv:
+        run_service_stream(platform_note)
+        return
     if "--replicas" in sys.argv:
         try:
             n_replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
@@ -1057,6 +1060,197 @@ def run_service(platform_note: str) -> None:
         "recovered_requests": stats["recovered_requests"],
         # Same host-drift armor as the batch rows (ISSUE-4 satellites):
         # best rep + full spread + cold/warm split + host fingerprint.
+        "rep_times_s": [round(t, 3) for t in rep_times],
+        **cold_warm(rep_times),
+        "host_fingerprint": host_fingerprint(),
+        "probe_error": _PROBE_ERROR,
+        "autotune_plan": autotune_report(),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "platform_note": platform_note,
+    })
+
+
+def run_service_stream(platform_note: str) -> None:
+    """ISSUE-12 streaming mode (`python bench.py --service --stream`):
+    drive graftd's streaming-session surface over real HTTP and report
+    the live-monitor evidence — time-to-first-verdict (a seeded
+    violation surfacing MID-RUN, at an append response, not at finish),
+    per-segment append latency p50/p99, steady-state segments/s, and
+    the peak resident (undecided) row count under eviction. A resume
+    sub-phase (uncounted) restarts the daemon on the same journal and
+    finishes a half-streamed session, so `resumed_sessions` is measured
+    evidence, not a schema placeholder.
+
+    Shape knobs (env): JGRAFT_STREAM_BENCH_SESSIONS concurrent sessions
+    per rep (default 8), _SEGMENTS per session (default 16), _OPS per
+    segment (default 64). Rep discipline matches every service row:
+    one untimed warm-up, best-of-N with cold/warm split +
+    host_fingerprint."""
+    import random as _random
+    import tempfile
+    import threading
+
+    import jax
+
+    from jepsen_jgroups_raft_tpu.history.synth import (build_history,
+                                                       random_valid_history)
+    from jepsen_jgroups_raft_tpu.service import (CheckingService,
+                                                 ServiceClient,
+                                                 journal_enabled,
+                                                 serve_in_thread)
+
+    n_sessions = int(os.environ.get("JGRAFT_STREAM_BENCH_SESSIONS", "8"))
+    n_segments = int(os.environ.get("JGRAFT_STREAM_BENCH_SEGMENTS", "16"))
+    n_ops = int(os.environ.get("JGRAFT_STREAM_BENCH_OPS", "64"))
+
+    rng = _random.Random(20260804)
+    # Per-session op streams, pre-chopped into segments (synthesis off
+    # the clock). Segment = n_ops rows, so segments/s prices the whole
+    # ingest pipeline: HTTP + fsync + incremental encode + greedy/carry.
+    streams = []
+    for _ in range(n_sessions):
+        h = random_valid_history(rng, "register",
+                                 n_ops=n_segments * n_ops // 2,
+                                 n_procs=5, crash_p=0.02, max_crashes=3)
+        ops = [op.to_dict() for op in h.client_ops()]
+        k = max(1, -(-len(ops) // n_segments))
+        streams.append([ops[i:i + k] for i in range(0, len(ops), k)])
+    # the seeded violation: segment 1 is valid writes, segment 2 ends
+    # with an impossible read — time-to-first-verdict is open → the
+    # append response that carries the violation
+    bad_rows = []
+    for j in range(n_ops // 2):
+        bad_rows += [(0, "invoke", "write", j), (0, "ok", "write", j)]
+    bad_rows += [(1, "invoke", "read", None), (1, "ok", "read", -7)]
+    bad_ops = [op.to_dict() for op in build_history(bad_rows).client_ops()]
+
+    journal_tmp = (tempfile.mkdtemp(prefix="graftd-stream-journal-")
+                   if journal_enabled() else None)
+
+    def rm_journal_tmp():
+        if journal_tmp:
+            import shutil
+
+            shutil.rmtree(journal_tmp, ignore_errors=True)
+
+    service = CheckingService(store_root=None, name="graftd-bench",
+                              cache_capacity=0, journal_dir=journal_tmp)
+    httpd, port, _t = serve_in_thread(service)
+    client_url = f"http://127.0.0.1:{port}"
+    _CLEANUP.append(httpd.server_close)
+    _CLEANUP.append(service.shutdown)
+    _CLEANUP.append(rm_journal_tmp)
+
+    def wave():
+        """One rep: n_sessions streamed concurrently (open → append
+        every segment → finish, verdict asserted) plus the seeded-
+        violation session timing first-verdict latency."""
+        latencies: list = []
+        ttfv = [None]
+        lock = threading.Lock()
+
+        def producer(k):
+            cl = ServiceClient(client_url, timeout=60.0)
+            s = cl.stream(workload="register")
+            for seg in streams[k]:
+                t0 = time.perf_counter()
+                s.append(seg)
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+            fin = s.finish()
+            assert fin["status"] == "done" and fin["valid?"] is True, fin
+
+        def violator():
+            cl = ServiceClient(client_url, timeout=60.0)
+            t0 = time.perf_counter()
+            s = cl.stream(workload="register")
+            out = s.append(bad_ops[:n_ops])
+            assert "violation" not in out, "violation before deciding seg"
+            out = s.append(bad_ops[n_ops:])
+            assert out.get("violation"), out
+            ttfv[0] = time.perf_counter() - t0
+            fin = s.finish()
+            assert fin["valid?"] is False, fin
+
+        threads = [threading.Thread(target=producer, args=(k,),
+                                    daemon=True)
+                   for k in range(n_sessions)]
+        threads.append(threading.Thread(target=violator, daemon=True))
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return time.perf_counter() - t0, latencies, ttfv[0]
+
+    wave()  # warm-up: compile + daemon spin-up (uncounted)
+    beat()
+    (wall, latencies, ttfv), rep_times = best_of(wave)
+    total_segments = sum(len(s) for s in streams) + 2
+    # Counters snapshot BEFORE the restart below: the resume phase
+    # boots a fresh daemon whose counters describe only itself.
+    stats = service.stats()
+
+    # Resume sub-phase (uncounted): half-stream a session, restart the
+    # daemon on the same WAL, finish through the replayed session.
+    resumed = 0
+    if journal_tmp:
+        cl = ServiceClient(client_url, timeout=60.0)
+        s = cl.stream(workload="register")
+        for seg in streams[0][: max(1, len(streams[0]) // 2)]:
+            s.append(seg)
+        sid = s.session_id
+        httpd.shutdown()
+        httpd.server_close()
+        service.shutdown(wait=True)
+        _CLEANUP.remove(httpd.server_close)
+        _CLEANUP.remove(service.shutdown)
+        service = CheckingService(store_root=None, name="graftd-bench",
+                                  cache_capacity=0,
+                                  journal_dir=journal_tmp)
+        httpd, port, _t = serve_in_thread(service)
+        _CLEANUP.append(httpd.server_close)
+        _CLEANUP.append(service.shutdown)
+        cl = ServiceClient(f"http://127.0.0.1:{port}", timeout=60.0)
+        s2 = cl.stream(workload="register", session_id=sid, resume=True)
+        for seg in streams[0][max(1, len(streams[0]) // 2):]:
+            s2.append(seg)
+        fin = s2.finish()
+        assert fin["valid?"] is True and fin.get("resumed"), fin
+        resumed = service.stats()["resumed_sessions"]
+
+    httpd.shutdown()
+    httpd.server_close()
+    service.shutdown(wait=True)
+    rm_journal_tmp()
+    _CLEANUP.remove(httpd.server_close)
+    _CLEANUP.remove(service.shutdown)
+    _CLEANUP.remove(rm_journal_tmp)
+
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    p99 = latencies[min(len(latencies) - 1,
+                        int(0.99 * len(latencies)))] if latencies else 0.0
+    emit({
+        "metric": "service_stream_segments_per_sec",
+        "value": round(total_segments / wall, 2),
+        "unit": "segments/s",
+        "stream_sessions": stats["stream_sessions"],
+        "segments_total": stats["segments_total"],
+        "resumed_sessions": resumed,
+        "sessions_per_rep": n_sessions + 1,
+        "segments_per_session": n_segments,
+        "ops_per_segment": n_ops,
+        "time_s": round(wall, 3),
+        "time_to_first_verdict_s": round(ttfv, 4) if ttfv else None,
+        "append_p50_ms": round(p50 * 1000.0, 3),
+        "append_p99_ms": round(p99 * 1000.0, 3),
+        "peak_resident_rows": stats["peak_resident_rows"],
+        "stream_violations": stats["stream_violations"],
+        "journal_enabled": stats["journal_enabled"],
+        "journal_append_p50_ms": stats.get("journal_append_p50_ms"),
         "rep_times_s": [round(t, 3) for t in rep_times],
         **cold_warm(rep_times),
         "host_fingerprint": host_fingerprint(),

@@ -610,3 +610,95 @@ def run_chunked(launches: List[ChunkLaunch],
                          chunks_run=g.launches_run, evicted_rows=g.evicted,
                          early_exit=g.early_exit, tag=g.launch.tag)
             for g in groups]
+
+
+# ------------------------------------------------------- streaming carry
+
+
+#: Sentinel event budget for a stream carry: the session does not know
+#: its total event count, so `exhausted` (events_left ≤ 0) must never
+#: fire — retirement is decided by the session (decided flag / finish).
+STREAM_EVENTS_SENTINEL = 1 << 30
+
+#: Events per carried launch while catching a backlog up (the per-append
+#: suffix is usually far smaller and rides one padded launch).
+STREAM_FEED_CHUNK = 1024
+
+
+class CarriedScan:
+    """Re-entrant chunk carry for ONE streamed history row (ISSUE 12).
+
+    The chunked wavefront's carry (`ops/kernel_ir.chunk_step_fns`:
+    ``{inner, left}`` + decided/exhausted flags) already makes the scan
+    re-enterable at any chunk boundary; this class owns that carry
+    ACROSS appends of a streaming session instead of across chunks of
+    one launch. Each `feed` advances the identical `scan_step` sequence
+    the monolithic kernel would run over the concatenated stream —
+    suffix padding is EV_PAD no-op rows — so after feeding the whole
+    stream the (ok, overflow) pair is bitwise-identical to the one-shot
+    scan (the §14 soundness argument; chunk-chaining half pinned by
+    tests/test_kernel_ir.py, the cross-append half by
+    tests/test_stream.py).
+
+    Flags follow the frozen-verdict rule: `ok` is monotone, so the
+    moment it flips False mid-stream the verdict INVALID (or, with
+    `overflow`, escalate-to-host) is FINAL — no later append can
+    resurrect a dead frontier. That is what lets a session surface a
+    violation at the earliest deciding segment and then evict the row.
+
+    The kernel window is fixed at build time (`bucket_slots`); a
+    session whose window outgrows it rebuilds a wider carry and
+    re-feeds the accumulated stream (deterministic, so the rebuilt
+    carry equals an uninterrupted wider scan). `fits()` answers whether
+    a rebuild is needed.
+    """
+
+    def __init__(self, model, n_slots: int,
+                 n_configs: Optional[int] = None):
+        from ..ops.linear_scan import (DEFAULT_N_CONFIGS, bucket_slots,
+                                       make_sort_chunk_checker)
+
+        self.model = model
+        self.n_configs = int(n_configs or DEFAULT_N_CONFIGS)
+        # raises ValueError past MAX_SLOTS — the session escalates
+        self.slots_cap = bucket_slots(max(int(n_slots), 1))
+        init_fn, self._step = make_sort_chunk_checker(
+            model, self.n_configs, self.slots_cap)
+        self.carry = init_fn(
+            np.asarray([STREAM_EVENTS_SENTINEL], np.int32))
+        self.fed = 0          # events consumed (pre-padding)
+        self.launches = 0
+        self.ok = True
+        self.overflow = False
+
+    @property
+    def decided(self) -> bool:
+        """Frozen-verdict retirement: ~ok is final mid-stream."""
+        return not self.ok
+
+    def fits(self, n_slots: int) -> bool:
+        return int(n_slots) <= self.slots_cap
+
+    def feed(self, events: np.ndarray) -> None:
+        """Advance the carry over an event suffix ([n, 5] int32).
+        Stops early (evicts) the moment the row decides — the remaining
+        suffix cannot change a frozen verdict."""
+        n = int(events.shape[0])
+        lo = 0
+        while lo < n and not self.decided:
+            span = events[lo:lo + STREAM_FEED_CHUNK]
+            lo += span.shape[0]
+            pad = bucket_rows(span.shape[0], 32)
+            if pad != span.shape[0]:
+                padded = np.zeros((pad, 5), dtype=np.int32)
+                padded[: span.shape[0]] = span
+                span = padded
+            carry, _dec, _exh, ok, overflow = self._step(
+                self.carry, span[None, :, :])
+            self.carry = carry
+            # blocks: device → host (the per-append sync point)
+            self.ok = bool(np.asarray(ok)[0])  # lint: allow(host-sync)
+            self.overflow = bool(
+                np.asarray(overflow)[0])       # lint: allow(host-sync)
+            self.launches += 1
+        self.fed += n

@@ -40,6 +40,88 @@ LOG = logging.getLogger("jgraft.core")
 #: seconds between generator polls when PENDING.
 POLL_INTERVAL = 0.002
 
+#: default ops per live-stream segment (`live_stream` test key).
+LIVE_STREAM_FLUSH_OPS = 64
+
+
+class _LiveStreamFeeder:
+    """Producer side of a streaming verdict session (ISSUE 12): a
+    running test streams its client ops to graftd AS THEY COMPLETE, so
+    the checker acts as a live monitor instead of a postmortem tool.
+
+    `record()` is called under the history lock and must stay O(1): it
+    buffers the op dict and hands full segments to a feeder thread,
+    which appends them over HTTP with the client's idempotent
+    per-segment retry. Every failure is absorbed (logged once, feeder
+    disabled) — live streaming is an OBSERVER; it must never stall or
+    kill the run it watches."""
+
+    def __init__(self, cfg: dict):
+        from ..service.client import ServiceClient, StreamSession
+
+        self.flush_ops = int(cfg.get("flush_ops", LIVE_STREAM_FLUSH_OPS))
+        client = ServiceClient(cfg["url"],
+                               timeout=float(cfg.get("timeout_s", 30.0)))
+        self.session = StreamSession(
+            client, workload=cfg.get("workload", "register"),
+            algorithm=cfg.get("algorithm", "auto"))
+        self.session.open()
+        self._buf: list = []
+        self._q: list = []
+        self._cond = threading.Condition()
+        self._dead = False
+        self._closing = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="live-stream")
+        self._thread.start()
+
+    def record(self, op) -> None:
+        if self._dead or op.process == NEMESIS:
+            return
+        self._buf.append(op.to_dict())
+        if len(self._buf) >= self.flush_ops:
+            buf, self._buf = self._buf, []
+            with self._cond:
+                self._q.append(buf)
+                self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closing:
+                    self._cond.wait(0.2)
+                if not self._q and self._closing:
+                    return
+                seg = self._q.pop(0)
+            try:
+                self.session.append(seg)
+            except Exception:
+                LOG.exception("live-stream append failed; streaming "
+                              "disabled for this run")
+                self._dead = True
+                with self._cond:
+                    self._q.clear()
+                return
+
+    def close(self) -> Optional[dict]:
+        """Flush the tail, finish the session, return the final stream
+        record (None when streaming died mid-run)."""
+        if self._buf and not self._dead:
+            with self._cond:
+                self._q.append(self._buf)
+        self._buf = []
+        with self._cond:
+            self._closing = True
+            self._cond.notify()
+        self._thread.join(60.0)
+        if self._dead:
+            return None
+        try:
+            return self.session.finish()
+        except Exception:
+            LOG.exception("live-stream finish failed")
+            return None
+
 
 def _open_client(proto, test: dict, node: str):
     """open + setup as ONE acquisition: when setup raises, the half-open
@@ -121,10 +203,28 @@ def run_test(test: dict) -> dict:
     history = History()
     hlock = threading.Lock()
 
+    # Live streaming (ISSUE 12): `live_stream` is either a config dict
+    # ({"url": "http://host:port", "workload"?, "flush_ops"?}) or a
+    # ready feeder-like object with record/close. A feeder that fails
+    # to OPEN degrades to no streaming — the run must not depend on the
+    # monitor being up.
+    feeder = None
+    live_cfg = test.get("live_stream")
+    if live_cfg is not None:
+        try:
+            feeder = (live_cfg if hasattr(live_cfg, "record")
+                      else _LiveStreamFeeder(dict(live_cfg)))
+        except Exception:
+            LOG.exception("live-stream open failed; running without a "
+                          "live monitor")
+            feeder = None
+
     def record(op: Op) -> Op:
         with hlock:
             op.time = sched.now()
             history.append(op)  # assigns index
+            if feeder is not None:
+                feeder.record(op)
             return op
 
     db = test.get("db")
@@ -273,6 +373,13 @@ def run_test(test: dict) -> dict:
     for t in threads:
         t.join()
 
+    live_result = None
+    if feeder is not None:
+        try:
+            live_result = feeder.close()
+        except Exception:
+            LOG.exception("live-stream close failed")
+
     # Prepare the run directory BEFORE log collection so DBs that download
     # node logs (ssh tier) can place them inside this run's store dir.
     if test.get("store", True) and "store_dir" not in test:
@@ -310,6 +417,11 @@ def run_test(test: dict) -> dict:
             test["results"].setdefault("scan-stats", scan)
     else:
         test["results"] = {"valid?": True, "note": "no checker"}
+    if live_result is not None and isinstance(test["results"], dict):
+        # the streamed verdict rides beside the local checker's (they
+        # agree on valid? by the §14 identity; the stream record adds
+        # mid-run detection metadata — decided-at-segment etc.)
+        test["results"].setdefault("live-stream", live_result)
 
     if test.get("store", True):
         save_test(test, history, test["results"])

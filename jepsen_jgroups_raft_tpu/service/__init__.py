@@ -30,13 +30,20 @@ macro compaction) across many independent submissions:
 * cluster.py   — replica membership leases, load shedding with the
                  cluster's best retry-after, and cross-replica journal
                  handoff (claim-by-rename, replay, re-own).
+* stream.py    — streaming verdict sessions (ISSUE 12): session-keyed
+                 segment ingest, per-segment greedy fast path, carried
+                 chunk-scan re-entry, live mid-run violation surfacing,
+                 WAL-backed crash resume and idle-park, per-session
+                 flow-control budgets.
 """
 
 from .admission import QueueFull, ServiceStopped  # noqa: F401
 from .client import ServiceClient, ServiceError  # noqa: F401
+from .client import StreamSession as ClientStreamSession  # noqa: F401
 from .cluster import ClusterManager, discover_replica_urls  # noqa: F401
 from .daemon import CheckingService  # noqa: F401
 from .http import make_server, serve_checker, serve_in_thread  # noqa: F401
 from .journal import AdmissionJournal, journal_enabled  # noqa: F401
 from .request import CheckRequest  # noqa: F401
 from .store import ResultStore  # noqa: F401
+from .stream import StreamBusy, StreamConflict, StreamManager  # noqa: F401

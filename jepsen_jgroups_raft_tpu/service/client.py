@@ -339,6 +339,20 @@ class ServiceClient:
     def healthz(self) -> dict:
         return self._call("GET", "/healthz")
 
+    def stream(self, workload: str = "register", units: int = 1,
+               algorithm: str = "auto",
+               consistency: str = "linearizable",
+               session_id: Optional[str] = None,
+               resume: bool = False) -> "StreamSession":
+        """Open (or resume) a streaming verdict session (ISSUE 12);
+        returns a `StreamSession` whose `append`/`finish` carry the
+        per-segment idempotent retry discipline."""
+        s = StreamSession(self, workload=workload, units=units,
+                          algorithm=algorithm, consistency=consistency,
+                          session_id=session_id, resume=resume)
+        s.open()
+        return s
+
     def check(self, histories: Sequence, workload: str = "register",
               algorithm: str = "auto", timeout_s: float = 300.0,
               poll_s: float = 0.05,
@@ -361,3 +375,103 @@ class ServiceClient:
                 raise TimeoutError(
                     f"request {rec['id']} still {rec.get('status')} after "
                     f"{timeout_s:.0f}s")
+
+
+class StreamSession:
+    """Producer-side streaming session (ISSUE 12 tentpole (d)).
+
+    Wraps one server-side stream session: `open` / `append` / `finish`
+    with the per-segment idempotent retry discipline. The client owns
+    the sequence numbers; a segment whose response was lost (connection
+    error, daemon SIGKILL mid-call) is simply RE-SENT under the same
+    seq — the server's duplicate detection (payload digest) makes the
+    retry a no-op when the first copy landed, and the WAL makes it
+    durable when it did not. 429/503/connection retries ride the
+    owning ServiceClient's backoff (idempotent by construction, so the
+    same safety argument as /submit applies).
+
+    A crashed PRODUCER is recoverable too: a fresh process constructs
+    the session with ``session_id=<sid>, resume=True`` — the server
+    answers with its current state (including ``next_seq``), and the
+    new producer continues from there (the kill-the-client scenario in
+    scripts/chaos_graftd.py).
+    """
+
+    def __init__(self, client: ServiceClient, workload: str = "register",
+                 units: int = 1, algorithm: str = "auto",
+                 consistency: str = "linearizable",
+                 session_id: Optional[str] = None,
+                 resume: bool = False):
+        self.client = client
+        self.workload = workload
+        self.units = units
+        self.algorithm = algorithm
+        self.consistency = consistency
+        self.session_id = session_id
+        self.resume = resume
+        self.seq = 1
+        self.last_state: Optional[dict] = None
+
+    def open(self) -> dict:
+        body = {"workload": self.workload, "units": self.units,
+                "algorithm": self.algorithm,
+                "consistency": self.consistency}
+        if self.session_id:
+            body["session"] = self.session_id
+        if self.resume:
+            body["resume"] = True
+        rec = self.client._call("POST", "/stream/open", body)
+        self.session_id = rec["session"]
+        self.seq = int(rec.get("next_seq", 1))
+        self.last_state = rec
+        return rec
+
+    @staticmethod
+    def _rows(ops) -> list:
+        if hasattr(ops, "to_dicts"):
+            return ops.to_dicts()
+        return [op.to_dict() if hasattr(op, "to_dict") else dict(op)
+                for op in ops]
+
+    def append(self, ops) -> dict:
+        """Append one segment (a flat op list for single-unit sessions,
+        or one list per unit). Assigns the next seq; safe to call again
+        after any transport failure — the seq/digest pair makes the
+        resend idempotent."""
+        if ops and not isinstance(ops[0], (list, tuple)) \
+                or hasattr(ops, "to_dicts"):
+            payload = self._rows(ops)
+        elif ops and isinstance(ops[0], (list, tuple)):
+            payload = [self._rows(u) for u in ops]
+        else:
+            payload = list(ops)
+        seq = self.seq
+        # An honest retry of a landed-but-unanswered segment re-sends
+        # the IDENTICAL payload and gets 200 {duplicate: true} from the
+        # digest check — so any 409 here is a REAL conflict (a second
+        # producer on the same session, or a client bug) and must
+        # surface, never be silently resynced past: swallowing it would
+        # drop a segment the server explicitly refused to merge.
+        rec = self.client._call("POST", "/stream/append", {
+            "session": self.session_id, "seq": seq, "ops": payload})
+        self.seq = seq + 1
+        self.last_state = rec
+        return rec
+
+    def status(self) -> dict:
+        rec = self.client._call(
+            "GET", f"/stream/status?session={self.session_id}")
+        self.last_state = rec
+        return rec
+
+    def finish(self) -> dict:
+        rec = self.client._call("POST", "/stream/finish",
+                                {"session": self.session_id})
+        self.last_state = rec
+        return rec
+
+    @property
+    def violation(self) -> Optional[dict]:
+        """The first mid-run violation the daemon has surfaced, if
+        any (from the most recent response)."""
+        return (self.last_state or {}).get("violation")

@@ -392,7 +392,15 @@ class ClusterManager:
                 self.store.put(sub["fingerprint"], term["results"])
         taken = self.service.adopt_requests(replayed["unfinished"],
                                             origin=origin)
-        if taken < len(replayed["unfinished"]):
+        # Stream sessions ride the same claim (ISSUE 12): re-journaled
+        # under our lease, then restored as resumable stubs — the
+        # producer's next append (404-failover finds us) resumes where
+        # the dead replica's WAL left off.
+        streams = replayed.get("streams") or {}
+        streams_taken = self.service.streams.adopt(streams,
+                                                   origin=origin)
+        if taken < len(replayed["unfinished"]) \
+                or streams_taken < len(streams):
             # our own shutdown interrupted the adoption: keep the
             # claimed dir (exclusively ours by the rename) so a peer —
             # or our restart — re-adopts once OUR lease expires; the

@@ -42,6 +42,7 @@ from .journal import AdmissionJournal, decode_request, journal_enabled
 from .request import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, CheckRequest,
                       admit, admit_run_dir)
 from .scheduler import BatchScheduler, ShardLoads
+from .stream import StreamManager
 
 LOG = logging.getLogger("jgraft.service")
 
@@ -257,6 +258,10 @@ class CheckingService:
                     and self.store_root is not None:
                 self._migrate_legacy_journal(root)
             self._journal = AdmissionJournal(root, retain=self._retain)
+        # Streaming session tier (ISSUE 12): always constructed — the
+        # in-memory mode works without a journal; crash resume and
+        # idle-park resumability need one (stream.py docstring).
+        self.streams = StreamManager(self)
         if self._journal is not None:
             self._recover()
         if autostart:
@@ -387,11 +392,20 @@ class CheckingService:
         with self._lock:
             while len(self._terminal) > self._retain:
                 self._requests.pop(self._terminal.popleft(), None)
-        if recovered or replayed["finished"] or replayed["skipped"]:
+        # Stream sessions (ISSUE 12): finished ones restore as terminal
+        # stubs, unfinished ones as parked RESUMABLE stubs — the first
+        # post-restart touch replays their journaled segments through
+        # the identical pipeline (boot stays fast; rebuild is lazy).
+        streams = replayed.get("streams") or {}
+        if streams:
+            self.streams.restore(streams)
+        if recovered or replayed["finished"] or replayed["skipped"] \
+                or streams:
             LOG.info("%s journal replay: %d unfinished requeued, %d "
-                     "finished restored, %d corrupt/truncated record(s) "
-                     "skipped", self.name, len(recovered),
-                     len(replayed["finished"]), replayed["skipped"])
+                     "finished restored, %d stream session(s) restored, "
+                     "%d corrupt/truncated record(s) skipped", self.name,
+                     len(recovered), len(replayed["finished"]),
+                     len(streams), replayed["skipped"])
 
     def adopt_requests(self, reqs, origin: str = "") -> int:
         """Re-own an expired replica's unfinished journal entries
@@ -542,6 +556,11 @@ class CheckingService:
             if r.finish(FAILED, error="service shut down before execution"):
                 self._count("failed")
             self._retire(r)
+        # Stream sessions survive shutdown BY DESIGN (unlike queued
+        # batch requests, which are failed loudly above): their
+        # journaled segments make them resumable — a clean restart is
+        # indistinguishable from a crash to a streaming producer.
+        self.streams.shutdown()
         if self._journal is not None:
             self._journal.close()
 
@@ -982,6 +1001,7 @@ class CheckingService:
         out["cluster_enabled"] = self.cluster is not None
         if self.cluster is not None:
             out.update(self.cluster.stats())
+        out.update(self.streams.stats())
         return out
 
     # ----------------------------------------------------- accounting

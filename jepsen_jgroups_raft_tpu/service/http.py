@@ -18,6 +18,23 @@ Endpoints::
     GET  /stats                        → 200 service counters
     GET  /healthz                      → 200 {"ok": true, "worker_alive"}
 
+Streaming sessions (ISSUE 12)::
+
+    POST /stream/open    {"workload"?, "units"?, "algorithm"?,
+                          "consistency"?, "session"?, "resume"?}
+                                       → 200 session state
+                                       → 429 past the session cap
+                                       → 409 id exists (without resume)
+    POST /stream/append  {"session", "seq", "ops": [op…] | [[op…]…]}
+                                       → 200 live state (violations
+                                         surface HERE, mid-run)
+                                       → 409 {"expected_seq"} on gaps /
+                                         reused-seq payload mismatch
+                                       → 429 {"retry_after_s"} over the
+                                         session's segment/byte budget
+    POST /stream/finish  {"session"}   → 200 final record (idempotent)
+    GET  /stream/status?session=ID     → 200 session state
+
 Run it: ``python -m jepsen_jgroups_raft_tpu serve-checker`` (cli.py) or
 embed via `make_server` (tests, the bench's --service mode).
 """
@@ -32,6 +49,7 @@ from typing import Optional, Tuple
 
 from .admission import QueueFull
 from .daemon import CheckingService, ServiceStopped
+from .stream import StreamBusy, StreamConflict
 
 #: Submission body size cap (bytes): 64 MiB of JSON ops is far beyond
 #: any legitimate history batch and bounds admission-side memory.
@@ -101,6 +119,14 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/stats":
             self._send(200, self.service.stats())
             return
+        if path == "/stream/status":
+            try:
+                self._send(200, self.service.streams.status(
+                    q.get("session", "")))
+            except KeyError:
+                self._send(404, {"error": f"unknown stream session "
+                                          f"{q.get('session', '')!r}"})
+            return
         if path == "/result":
             req = self.service.get(q.get("id", ""))
             if req is None:
@@ -128,6 +154,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/submit":
             self._submit(body)
             return
+        if path.startswith("/stream/"):
+            self._stream(path, body)
+            return
         if path == "/cancel":
             status = self.service.cancel(str(body.get("id", "")))
             if status is None:
@@ -137,6 +166,55 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, {"id": body.get("id"), "status": status})
             return
         self._send(404, {"error": f"no such endpoint {path!r}"})
+
+    def _stream(self, path: str, body: dict) -> None:
+        """Streaming-session endpoints (ISSUE 12). The error taxonomy
+        mirrors /submit: flow control → 429 + Retry-After (the
+        backoff-retrying client treats both surfaces uniformly),
+        sequencing conflicts → 409 carrying `expected_seq`, malformed
+        input → 400, unknown session → 404."""
+        streams = self.service.streams
+        handlers = {
+            "/stream/open": lambda: streams.open(
+                workload=str(body.get("workload", "register")),
+                units=body.get("units", 1),
+                algorithm=str(body.get("algorithm", "auto")),
+                consistency=str(body.get("consistency",
+                                         "linearizable")),
+                session_id=body.get("session"),
+                resume=bool(body.get("resume"))),
+            "/stream/append": lambda: streams.append(
+                str(body.get("session", "")), body.get("seq"),
+                body.get("ops") or [],
+                n_bytes=int(self.headers.get("Content-Length") or 0)),
+            "/stream/finish": lambda: streams.finish(
+                str(body.get("session", ""))),
+        }
+        handler = handlers.get(path)
+        if handler is None:
+            self._send(404, {"error": f"no such endpoint {path!r}"})
+            return
+        try:
+            out = handler()
+        except KeyError as e:
+            self._send(404, {"error": f"unknown stream session "
+                                      f"{e.args[0]!r}"})
+            return
+        except StreamBusy as e:
+            self._send(429, {"error": str(e),
+                             "retry_after_s": e.retry_after_s},
+                       {"Retry-After": str(max(1, int(e.retry_after_s)))})
+            return
+        except StreamConflict as e:
+            payload = {"error": str(e)}
+            if e.expected_seq is not None:
+                payload["expected_seq"] = e.expected_seq
+            self._send(409, payload)
+            return
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, out)
 
     def _submit(self, body: dict) -> None:
         try:

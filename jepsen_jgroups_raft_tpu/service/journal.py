@@ -69,6 +69,19 @@ LOG = logging.getLogger("jgraft.service")
 #: loudly (skip + count) instead of misparsing them.
 JOURNAL_VERSION = 1
 
+#: Stream-record family version (ISSUE 12). Stream sessions put MANY
+#: records under one session id (open / per-segment / fin) — a distinct
+#: record family from the submit/terminal pairs, versioned separately so
+#: the streaming wire format can evolve without bumping the whole WAL
+#: schema: replay skips NEWER stream records loudly while still
+#: replaying every request record, and a pre-PR-12 WAL (no stream
+#: records at all) replays byte-for-byte as before (the forward-compat
+#: fixture test in tests/test_stream.py pins both directions).
+STREAM_VERSION = 1
+
+#: The stream record kinds (`kind` field values).
+STREAM_KINDS = ("stream-open", "stream-seg", "stream-fin")
+
 #: Appends timed for the bench's admission-overhead evidence
 #: (`journal_append_p50_ms` in `bench.py --service` rows).
 APPEND_WINDOW = 4096
@@ -194,6 +207,64 @@ def decode_request(rec: dict) -> CheckRequest:
     )
 
 
+def encode_stream_open(sid: str, workload: str, model_name: str,
+                       algorithm: str, consistency: str,
+                       n_units: int) -> dict:
+    """Stream session-open record (ISSUE 12)."""
+    return {
+        "kind": "stream-open",
+        "v": JOURNAL_VERSION,
+        "stream_v": STREAM_VERSION,
+        "sid": sid,
+        "workload": workload,
+        "model": model_name,
+        "algorithm": algorithm,
+        "consistency": consistency,
+        "units": int(n_units),
+        "opened_wall": time.time(),
+    }
+
+
+def encode_stream_segment(sid: str, seq: int, unit_ops, digest: str) -> dict:
+    """One appended segment: the RAW op dict rows per unit (replay
+    re-feeds them through the same incremental encoder the live path
+    used, so the rebuilt carry is deterministic), plus the payload
+    digest duplicate-detection keys on."""
+    return {
+        "kind": "stream-seg",
+        "v": JOURNAL_VERSION,
+        "stream_v": STREAM_VERSION,
+        "sid": sid,
+        "seq": int(seq),
+        "digest": digest,
+        "ops": unit_ops,
+    }
+
+
+def encode_stream_fin(sid: str, status: str, results=None,
+                      error=None) -> dict:
+    """Terminal marker for a stream session. Results ride along for a
+    clean finish (same never-persist-degraded rule as request
+    terminals) so `/stream/status` answers across a restart without a
+    rebuild."""
+    rec = {
+        "kind": "stream-fin",
+        "v": JOURNAL_VERSION,
+        "stream_v": STREAM_VERSION,
+        "sid": sid,
+        "status": status,
+    }
+    if error is not None:
+        rec["error"] = str(error)[:500]
+    if results is not None and not any(
+            isinstance(r, dict) and "platform-degraded" in r
+            for r in results):
+        from ..core.store import _jsonable
+
+        rec["results"] = _jsonable(results)
+    return rec
+
+
 class AdmissionJournal:
     """Append-only WAL at ``<root>/wal.jsonl`` (root is
     ``store/<service>/journal/`` in the daemon's layout)."""
@@ -273,6 +344,20 @@ class AdmissionJournal:
             self.compact()
         return ok
 
+    def append_stream(self, rec: dict) -> bool:
+        """Append one stream-family record (open/segment/fin), fsync'd —
+        the append path's 2xx must not become visible before the segment
+        is durable (ISSUE 12). Same degrade-not-refuse stance as every
+        other append."""
+        ok = self._append(rec, fsync=True)
+        if rec.get("kind") == "stream-fin":
+            with self._lock:
+                self._finished_since_compact += 1
+                should = self._finished_since_compact > 2 * self.retain
+            if should:
+                self.compact()
+        return ok
+
     # ----------------------------------------------------------- replay
 
     def _scan(self):
@@ -311,19 +396,61 @@ class AdmissionJournal:
 
             {"unfinished": [CheckRequest…]   # deadline order
              "finished":   [(submit_rec, terminal_rec)…],
+             "streams":    {sid: {"open": rec, "segments": [rec…],
+                                  "fin": rec | None}},
              "skipped":    int}              # corrupt/truncated lines
 
         Submit records that fail to DECODE (unknown model, mangled
         tensor payload) are skipped loudly like torn lines — replay
-        must deliver every intact entry even when one is poison."""
+        must deliver every intact entry even when one is poison.
+        Stream records (ISSUE 12) are their OWN record family — many
+        records per session id, versioned by ``stream_v`` — grouped
+        per session; a record from a NEWER stream version is skipped
+        loudly without touching the request replay, and a WAL with no
+        stream records (pre-PR-12) replays exactly as before."""
         records, skipped = self._scan()
         submits = {}
         terminals = {}
+        streams: dict = {}
         for rec in records:
-            if rec.get("kind") == "submit":
+            kind = rec.get("kind")
+            if kind == "submit":
                 submits[rec["id"]] = rec
-            elif rec.get("kind") == "terminal":
+            elif kind == "terminal":
                 terminals[rec["id"]] = rec
+            elif kind in STREAM_KINDS:
+                try:
+                    if int(rec.get("stream_v", -1)) > STREAM_VERSION:
+                        raise ValueError(
+                            f"stream record version {rec.get('stream_v')} "
+                            f"is newer than this daemon ({STREAM_VERSION})")
+                    sid = str(rec["sid"])
+                except (ValueError, KeyError, TypeError) as e:
+                    skipped += 1
+                    LOG.warning("journal stream record skipped: %s", e)
+                    continue
+                s = streams.setdefault(
+                    sid, {"open": None, "segments": [], "fin": None})
+                if kind == "stream-open":
+                    s["open"] = rec
+                elif kind == "stream-fin":
+                    s["fin"] = rec
+                else:
+                    s["segments"].append(rec)
+        # Orphaned segments (their open record was corrupt/compacted
+        # away) cannot rebuild a session: skipped loudly, not silently.
+        for sid in [k for k, s in streams.items() if s["open"] is None]:
+            skipped += len(streams[sid]["segments"])
+            LOG.warning("journal stream %s has segments but no open "
+                        "record; session dropped", sid)
+            del streams[sid]
+        for s in streams.values():
+            # duplicate seqs are first-wins (a retried append whose 2xx
+            # was lost journals twice; the payloads are digest-equal)
+            seen: dict = {}
+            for rec in s["segments"]:
+                seen.setdefault(int(rec.get("seq", -1)), rec)
+            s["segments"] = [seen[k] for k in sorted(seen)]
         unfinished: List[CheckRequest] = []
         finished = []
         for rid, rec in submits.items():
@@ -340,24 +467,76 @@ class AdmissionJournal:
         with self._lock:
             # replay doubles as the finished-pair census that seeds the
             # compaction trigger (no separate counting scan at open)
-            self._finished_since_compact = len(finished)
+            self._finished_since_compact = len(finished) + sum(
+                1 for s in streams.values() if s["fin"] is not None)
         return {"unfinished": unfinished, "finished": finished,
-                "skipped": skipped}
+                "streams": streams, "skipped": skipped}
+
+    def stream_records(self, sid: str) -> Optional[dict]:
+        """Re-scan the WAL for ONE session's stream records (the revive
+        path of a parked/restored session — parking drops the records
+        from memory on purpose; a revive pays one file scan, and never
+        the tensor decode `replay()` does for request records). Returns
+        the same per-session dict `replay()["streams"]` holds, or None
+        when the session has no (intact) open record."""
+        sid = str(sid)
+        records, _ = self._scan()
+        out = {"open": None, "segments": [], "fin": None}
+        seen: dict = {}
+        for rec in records:
+            kind = rec.get("kind")
+            if kind not in STREAM_KINDS or str(rec.get("sid")) != sid:
+                continue
+            try:
+                if int(rec.get("stream_v", -1)) > STREAM_VERSION:
+                    continue  # replay() already logged these
+            except (ValueError, TypeError):
+                continue
+            if kind == "stream-open":
+                out["open"] = rec
+            elif kind == "stream-fin":
+                out["fin"] = rec
+            else:
+                seen.setdefault(int(rec.get("seq", -1)), rec)
+        out["segments"] = [seen[k] for k in sorted(seen)]
+        return out if out["open"] is not None else None
 
     # ------------------------------------------------------- compaction
 
     def compact(self) -> None:
         """Rewrite the WAL: every unfinished entry survives, only the
         newest `retain` finished pairs do. Atomic via temp+replace —
-        a crash mid-compaction leaves a valid journal either way."""
+        a crash mid-compaction leaves a valid journal either way.
+
+        Stream sessions (ISSUE 12) follow the same rule in their own
+        family: an UNFINISHED session keeps every record (open + all
+        segments — that is the resumability payload), a finished one
+        keeps only its open+fin pair (status stays queryable across a
+        restart; the segment payloads are dead weight once a terminal
+        verdict exists), bounded to the newest `retain` finished
+        sessions."""
         with self._lock:
             records, _ = self._scan()
             terminals = {r["id"]: r for r in records
                          if r.get("kind") == "terminal"}
+            stream_fins = {str(r.get("sid")): r for r in records
+                           if r.get("kind") == "stream-fin"}
+            # finished sessions, oldest first (fin record file order)
+            fin_order = list(stream_fins)
+            drop_fins = set(fin_order[:-self.retain]
+                            if self.retain else fin_order)
             keep: List[dict] = []
             finished_pairs = []
             for rec in records:
-                if rec.get("kind") != "submit":
+                kind = rec.get("kind")
+                if kind in STREAM_KINDS:
+                    sid = str(rec.get("sid"))
+                    if sid not in stream_fins:
+                        keep.append(rec)      # unfinished: keep whole
+                    elif kind != "stream-seg" and sid not in drop_fins:
+                        keep.append(rec)      # finished: open+fin only
+                    continue
+                if kind != "submit":
                     continue
                 term = terminals.get(rec["id"])
                 if term is None:
@@ -383,8 +562,9 @@ class AdmissionJournal:
                 LOG.warning("journal compaction failed; keeping the "
                             "uncompacted WAL", exc_info=True)
                 return
-            self._finished_since_compact = min(
-                len(finished_pairs), self.retain)
+            self._finished_since_compact = (
+                min(len(finished_pairs), self.retain)
+                + len(stream_fins) - len(drop_fins))
 
     # ------------------------------------------------------------ stats
 

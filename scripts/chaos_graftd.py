@@ -278,6 +278,100 @@ def phase_journal_off(rng: random.Random) -> None:
             proc.wait(30)
 
 
+# ------------------------------- phase 2b: streaming sessions (ISSUE 12)
+
+
+def _chop(history, n_segments: int):
+    ops = [op.to_dict() for op in history.client_ops()]
+    k = max(1, -(-len(ops) // n_segments))
+    return [ops[i:i + k] for i in range(0, len(ops), k)]
+
+
+def phase_stream_sigkill(rng: random.Random) -> None:
+    """SIGKILL mid-stream + kill-the-client (ISSUE 12): nothing
+    appended is lost, the resumed session's verdict equals a direct
+    check of the full history, and a violation already surfaced
+    mid-run survives the restart at the same deciding segment."""
+    print("phase 2b: streaming sessions — SIGKILL mid-stream, "
+          "kill-the-client, violation-at-segment across restart")
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+    from jepsen_jgroups_raft_tpu.history.synth import (build_history,
+                                                       random_valid_history)
+    from jepsen_jgroups_raft_tpu.models import CasRegister
+    from jepsen_jgroups_raft_tpu.service import ServiceClient
+
+    good = random_valid_history(random.Random(rng.randrange(1 << 30)),
+                                "register", n_ops=48, crash_p=0.05)
+    good_segs = _chop(good, 3)
+    rows = []
+    for j in range(8):
+        rows += [(0, "invoke", "write", j), (0, "ok", "write", j)]
+    bad = build_history(rows + [(1, "invoke", "read", None),
+                                (1, "ok", "read", -7)])
+    bad_ops = [op.to_dict() for op in bad.client_ops()]
+    [want_good] = [r["valid?"] for r in
+                   check_histories([good.client_ops()], CasRegister())]
+    with tempfile.TemporaryDirectory(prefix="chaos-graftd-stream-") \
+            as store:
+        proc, client = spawn_daemon(store, {})
+        try:
+            s = client.stream(workload="register")
+            for seg in good_segs[:2]:
+                s.append(seg)
+            sid_good = s.session_id
+            # the seeded violation: surfaces at the SECOND append,
+            # mid-run — not at finish
+            v = client.stream(workload="register")
+            out1 = v.append(bad_ops[:16])
+            out2 = v.append(bad_ops[16:])
+            sid_bad = v.session_id
+            check("violation" not in out1 and
+                  out2.get("violation", {}).get("decided-at-segment") == 2,
+                  "violation surfaced mid-run at the deciding segment "
+                  "(before any finish)")
+        finally:
+            # the fault under test; heal = the restart below
+            os.kill(proc.pid, signal.SIGKILL)  # lint: allow(unhealed)
+            proc.wait(30)
+        print("  ... SIGKILL delivered mid-stream; restarting")
+        proc, client2 = spawn_daemon(store, {})
+        try:
+            # kill-the-client is the same recovery shape: this is a NEW
+            # client process resuming by session id
+            s2 = client2.stream(workload="register",
+                                session_id=sid_good, resume=True)
+            check(s2.last_state.get("status") == "incomplete"
+                  or s2.seq == 3,
+                  "restored session is resumable with both pre-kill "
+                  f"segments intact (next_seq={s2.seq})")
+            check(s2.seq == 3,
+                  "nothing appended was lost across the SIGKILL "
+                  f"(next_seq={s2.seq})")
+            for seg in good_segs[2:]:
+                s2.append(seg)
+            fin = s2.finish()
+            check(fin["status"] == "done"
+                  and fin["valid?"] is want_good and fin.get("resumed"),
+                  "resumed stream verdict equals the direct "
+                  "check_histories verdict")
+            vstat = client2._call(
+                "GET", f"/stream/status?session={sid_bad}")
+            fin_bad = client2._call("POST", "/stream/finish",
+                                    {"session": sid_bad})
+            viol = fin_bad["results"][0]
+            check(fin_bad["valid?"] is False
+                  and viol.get("decided-at-segment") == 2,
+                  "pre-kill violation survives the restart at the same "
+                  "deciding segment "
+                  f"(status-resumable={vstat.get('status')!r})")
+            st = client2.stats()
+            check(st["resumed_sessions"] >= 2,
+                  f"journal resumed {st['resumed_sessions']} sessions")
+        finally:
+            proc.kill()  # lint: allow(unhealed) — phase over
+            proc.wait(30)
+
+
 # ------------------------------------- phase 3: in-process fault storm
 
 
@@ -559,6 +653,19 @@ def _phase_cluster(cdir, pairs, want, rng, n_replicas: int) -> None:
               f"{len(recs)} pending + 1 attached duplicate accepted on "
               "the victim replica")
 
+        # an OPEN stream session on the victim (ISSUE 12): the claim
+        # must carry it to a survivor, resumable, verdict intact
+        from jepsen_jgroups_raft_tpu.history.synth import (
+            random_valid_history)
+
+        sh = random_valid_history(random.Random(20260812), "register",
+                                  n_ops=36, crash_p=0.0)
+        ssegs = _chop(sh, 3)
+        vs = clients[0].stream(workload="register")
+        for seg in ssegs[:2]:
+            vs.append(seg)
+        stream_sid = vs.session_id
+
         os.kill(procs[0].pid, signal.SIGKILL)  # lint: allow(unhealed)
         procs[0].wait(30)  # heal = the surviving replicas' handoff
         print("  ... replica r0 SIGKILL'd; awaiting lease expiry + "
@@ -610,6 +717,43 @@ def _phase_cluster(cdir, pairs, want, rng, n_replicas: int) -> None:
         check(resub.get("cached") is True and s1["batches"] == s0["batches"],
               "invariant 3: post-kill resubmission is a cluster store/"
               "cache hit, no new batch")
+
+        # the open stream session was claimed with the WAL: find the
+        # survivor that adopted it and resume there (ISSUE 12)
+        from jepsen_jgroups_raft_tpu.checker.linearizable import (
+            check_histories)
+        from jepsen_jgroups_raft_tpu.models import CasRegister
+        from jepsen_jgroups_raft_tpu.service import ServiceError
+
+        adopter = None
+        for c in survivors:
+            try:
+                c._call("GET", f"/stream/status?session={stream_sid}")
+                adopter = c
+                break
+            except (ServiceError, OSError):
+                continue
+        check(adopter is not None,
+              "a survivor adopted the victim's open stream session")
+        if adopter is not None:
+            rs = adopter.stream(workload="register",
+                                session_id=stream_sid, resume=True)
+            check(rs.seq == 3,
+                  "no appended stream segment lost across the replica "
+                  f"kill (next_seq={rs.seq})")
+            for seg in ssegs[2:]:
+                rs.append(seg)
+            sfin = rs.finish()
+            [swant] = [r["valid?"] for r in check_histories(
+                [sh.client_ops()], CasRegister())]
+            check(sfin["status"] == "done" and sfin["valid?"] is swant,
+                  "cross-replica-resumed stream verdict equals the "
+                  "direct check")
+            sstats = [c.stats() for c in survivors]
+            check(sum(s.get("handoff_streams", 0) for s in sstats) >= 1,
+                  "stream handoff counted on exactly the claiming "
+                  f"survivor (handoff_streams="
+                  f"{[s.get('handoff_streams', 0) for s in sstats]})")
 
         # invariant 4: every survivor still serves fresh work
         for i, c in enumerate(survivors):
@@ -688,6 +832,9 @@ def main() -> int:
     ap.add_argument("--cluster-only", action="store_true",
                     help="run only the cluster phase (the CI cluster "
                          "smoke stage)")
+    ap.add_argument("--stream-only", action="store_true",
+                    help="run only the streaming-session phase (the CI "
+                         "streaming smoke stage)")
     args = ap.parse_args()
     n = args.requests or (8 if args.quick else 32)
     rng = random.Random(args.seed)
@@ -697,10 +844,13 @@ def main() -> int:
     t0 = time.monotonic()
     if args.cluster_only:
         phase_cluster(n, rng, max(2, n_replicas))
+    elif args.stream_only:
+        phase_stream_sigkill(rng)
     else:
         if not args.skip_subprocess:
             phase_sigkill(n, rng)
             phase_journal_off(rng)
+            phase_stream_sigkill(rng)
         phase_fault_storm(n, rng)
         phase_poison_and_hang(rng)
         if n_replicas >= 2 and not args.skip_subprocess:
