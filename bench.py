@@ -690,14 +690,18 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         from jepsen_jgroups_raft_tpu.checker.linearizable import \
             check_encoded
 
+        from jepsen_jgroups_raft_tpu.checker.schedule import consume_tiers
+
         sub = encs[:min(len(encs), 256)]
         check_encoded(sub, model, algorithm="jax",
                       consistency="sequential")  # warm-up: compile
         beat()
+        consume_tiers()  # drop the warm-up's tier counters
         t0 = time.perf_counter()
         rs = check_encoded(sub, model, algorithm="jax",
                            consistency="sequential")
         dt_seq = time.perf_counter() - t0
+        tiers = consume_tiers()
         emit({
             "metric": "sequential_rung_hist_per_sec",
             "value": round(len(sub) / dt_seq, 2),
@@ -708,6 +712,11 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
                 1 for r in rs if r.get("algorithm") == "greedy-witness"),
             "invalid_or_unknown": sum(
                 1 for r in rs if r.get("valid?") is not True),
+            # ISSUE 13: the fleet capacity metric — decided rows and
+            # wall seconds per decision-ladder tier for this row.
+            "decided_by_tier": {k: v["rows"] for k, v in tiers.items()},
+            "tier_wall_s": {k: round(v["wall_s"], 4)
+                            for k, v in tiers.items()},
             "time_s": round(dt_seq, 3),
             "platform": jax.devices()[0].platform,
         })
@@ -763,7 +772,8 @@ def run_suite(platform_note: str) -> None:
         return max(floor, int(n * scale))
 
     def timed(name, model, hists, model_family=None, consistency=None):
-        from jepsen_jgroups_raft_tpu.checker.schedule import consume_stats
+        from jepsen_jgroups_raft_tpu.checker.schedule import (consume_stats,
+                                                              consume_tiers)
 
         # No pinned capacity: the checker auto-routes (dense kernel where
         # the domain allows, capacity-laddered sort kernel otherwise).
@@ -775,6 +785,7 @@ def run_suite(platform_note: str) -> None:
         check_histories(hists, model, algorithm="jax", **kw)
         beat()
         consume_stats()  # drop the warm-up's chunked-scan counters
+        consume_tiers()
         # Best-of-3 like the north-star bench: single-shot suite rows
         # measured the tunnel's mood (config 4 read 3.08 hist/s in the
         # same session a warm in-process A/B measured 9.5).
@@ -782,6 +793,16 @@ def run_suite(platform_note: str) -> None:
             lambda: check_histories(hists, model, algorithm="jax", **kw))
         dt = min(times)
         scan = consume_stats()  # summed over the timed reps
+        tiers = consume_tiers()
+        # ISSUE 13 per-tier attribution: decided rows come from the
+        # LAST rep's verdicts (one batch's worth — deterministic);
+        # per-tier wall is the timed reps' sum (overlap caveats as the
+        # scan counters).
+        by_tier: dict = {}
+        for r in rs:
+            t = r.get("decided-tier")
+            if t is not None:
+                by_tier[t] = by_tier.get(t, 0) + 1
         bad = [r for r in rs if r["valid?"] is not True]
         kernels = sorted({r.get("kernel", r["algorithm"]) for r in rs})
         emit({"config": name, "histories": len(hists),
@@ -790,6 +811,11 @@ def run_suite(platform_note: str) -> None:
               "time_s": round(dt, 3),
               "histories_per_sec": round(len(hists) / dt, 2),
               "invalid_or_unknown": len(bad), "kernel": kernels,
+              "decided_by_tier": by_tier,
+              "decided_fraction": {k: round(v / max(len(rs), 1), 4)
+                                   for k, v in by_tier.items()},
+              "tier_wall_s": {k: round(v["wall_s"], 4)
+                              for k, v in tiers.items()},
               "rep_times_s": [round(t, 3) for t in times],
               **cold_warm(times),
               "evicted_rows": scan["evicted_rows"],
@@ -1058,6 +1084,10 @@ def run_service(platform_note: str) -> None:
         "journal_enabled": stats["journal_enabled"],
         "journal_append_p50_ms": stats.get("journal_append_p50_ms"),
         "recovered_requests": stats["recovered_requests"],
+        # ISSUE-13 tier attribution (process-lifetime gauge like the
+        # health counters): which decision-ladder tier decided the
+        # daemon's demuxed verdicts.
+        "decided_tier": stats["decided_tier"],
         # Same host-drift armor as the batch rows (ISSUE-4 satellites):
         # best rep + full spread + cold/warm split + host fingerprint.
         "rep_times_s": [round(t, 3) for t in rep_times],

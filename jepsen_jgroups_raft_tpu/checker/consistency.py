@@ -43,18 +43,21 @@ Soundness (doc/checker-design.md §12 for the full argument):
     reads + read-your-writes.
 
 Why the rungs are CHEAPER: a weaker rung admits more witnesses, so the
-one-pass greedy certifier below (O(events · window), pure host scan, no
-kernel launch) succeeds on the overwhelming majority of valid histories
-— the measured A/B win (scripts/ab_consistency.py). Rows greedy cannot
-certify fall through to the ordinary kernel ladder on the relaxed
-stream; greedy never *refutes*, so its answers are sound by
-construction (the committed order IS a witness).
+value-guided bounded-backtrack certifier below (an O(events · window)
+host scan with a fixed flip budget, no kernel launch) succeeds on the
+overwhelming majority of valid histories — the measured A/B win
+(scripts/ab_cheap_tier.py). Rows it cannot certify fall through to the
+ordinary kernel ladder on the relaxed stream; the certifier never
+*refutes*, so its answers are sound by construction (the committed
+order IS a witness). Soundness + tier ordering live in
+doc/checker-design.md §15.
 """
 
 from __future__ import annotations
 
 import heapq
-from typing import List, Optional, Sequence
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -96,6 +99,43 @@ def greedy_on() -> bool:
     ablation arm (rung verdicts must be identical either way, pinned by
     tests) and the A/B denominator."""
     return env_int("JGRAFT_GREEDY_CERTIFY", 1, minimum=0) != 0
+
+
+#: Default BASE flip budget for the bounded-backtrack certifier:
+#: enough to untangle the mutator ambiguity that defeats the pure
+#: greedy scan on the register/cas family (measured: 98/100 seeded
+#: 200-op register histories certify under 64 flips where PR-9 greedy
+#: managed 9/100), small enough that an adversarial history cannot
+#: turn the cheap tier into a search engine — undecided rows take the
+#: exact kernel ladder. The EFFECTIVE per-row budget scales with
+#: stream length (`_effective_budget`): wrong turns accumulate
+#: linearly with ops, so a flat budget silently starved long histories
+#: (1000-op register decided fraction 0.67 flat vs 1.0 scaled,
+#: measured at ~equal wall — undecided rows are the expensive ones).
+DEFAULT_BACKTRACK_BUDGET = 64
+
+#: Events per base-budget unit in the length scaling.
+_BUDGET_SCALE_EVENTS = 256
+
+#: Most-recent choice points kept restorable. Dropping the oldest when
+#: the stack outgrows this bounds certifier memory to
+#: O(cap · ops/word) regardless of history length; a search that needs
+#: deeper backtracking returns undecided (never wrong).
+_BACKTRACK_STACK_CAP = 128
+
+
+def greedy_backtrack_budget() -> int:
+    """Resolved BASE flip budget (JGRAFT_GREEDY_BACKTRACK; 0 restores
+    the PR-9 no-backtrack greedy behavior — the ablation arm)."""
+    return env_int("JGRAFT_GREEDY_BACKTRACK", DEFAULT_BACKTRACK_BUDGET,
+                   minimum=0)
+
+
+def _effective_budget(base: int, n_events: int) -> int:
+    """Per-row budget: the base, scaled linearly past
+    `_BUDGET_SCALE_EVENTS` events (64 at ≤256 events, ~448 at a
+    2000-event 1000-op register history)."""
+    return base * max(1, n_events // _BUDGET_SCALE_EVENTS)
 
 
 # ----------------------------------------------------- stream relaxation
@@ -209,149 +249,261 @@ def relax_encoded(enc: EncodedHistory, model,
                           proc=out_proc)
 
 
-# ------------------------------------------------------ greedy certifier
+# ----------------------------------- value-guided backtracking certifier
 
 
-def greedy_certify(enc: EncodedHistory, model) -> bool:
-    """One-pass witness construction on an encoded stream. Two commit
-    rules build the order:
+def _value_guide_masks(model, ops, forced):
+    """Per-op (enable_mask, observe_mask) bitmasks over the observed
+    value domain — GSet's membership-mask encoding trick applied to the
+    certifier's choice ordering: `enable_mask[k] & observe_mask[e]`
+    answers "can committing k expose a state e observes?" in one AND.
+    None when the model lacks the enable/observe hooks, answers None
+    for some op, or the domain outgrows the word — the step-lookahead
+    fallback then orders candidates instead (exact, just slower)."""
+    from ..models.base import EncodedOp
 
-      * EAGER observations: a pending READ-ONLY op (an opcode the model
-        declares in `readonly_fcodes` — never mutates at ANY state)
-        that is legal NOW commits immediately — provably lossless: if
-        any witness places a read-only op elsewhere, moving it to any
-        legal point yields another witness, so committing at the first
-        legal moment never forecloses anything. (The rule must key on
-        the opcode, not on "step preserved the state here": a write
-        that is a no-op at the CURRENT state can still be the mutation
-        a later read depends on.) This is what lets reads that
-        linearized early but completed late (the common shape under
-        concurrency) certify without search.
-      * LAZY mutations: a state-changing op commits only when its FORCE
-        demands it, or when a forced op needs its effect (older pending
-        ops are tried in open order).
+    if not (hasattr(model, "enable_values")
+            and hasattr(model, "observe_values")):
+        return None
+    dom: dict = {}
+    em = [0] * len(ops)
+    om = [0] * len(ops)
+    for k, (f, a, b) in enumerate(ops):
+        eo = EncodedOp(f, a, b, forced[k])
+        evs = model.enable_values(eo)
+        ovs = model.observe_values(eo)
+        if evs is None or ovs is None:
+            return None
+        for vals, masks in ((evs, em), (ovs, om)):
+            for v in vals:
+                if v not in dom:
+                    if len(dom) >= 63:
+                        return None
+                    dom[v] = len(dom)
+                masks[k] |= 1 << dom[v]
+    return em, om
 
-      * FORCED-FIRST retries: when a forced op needs older effects, the
-        retry pass offers ops that will themselves be forced (known
-        outcomes) before optional crashed ops — an always-legal
-        optional mutation (a crashed enqueue, an info add) committed
-        too eagerly poisons every later exact observation, so the
-        optionals are spent only when nothing forced helps.
 
-    Returns True iff a complete legal witness was built — the committed
-    order respects every op's [OPEN, FORCE] interval, so True is a
-    sound VALID for whatever rung produced the stream. False means
-    *undecided* (greedy took a wrong turn), never invalid; callers fall
-    through to the exact kernel ladder."""
+def certify_encoded(enc: EncodedHistory, model,
+                    budget: Optional[int] = None
+                    ) -> Tuple[bool, Optional[str], int]:
+    """Witness construction on an encoded stream, with value-guided
+    bounded backtracking (the ISSUE-13 widening of PR 9's one-pass
+    greedy). Returns ``(certified, tier, flips)`` — tier "greedy" when
+    the first-choice path succeeded, "backtrack" when recovering from
+    ``flips`` wrong turns did, None when undecided.
+
+    Commit rules (the PR-9 rules, now restartable):
+
+      * EAGER observations: a pending READ-ONLY op (an opcode in
+        `readonly_fcodes` — never mutates at ANY state) that is legal
+        NOW commits immediately — provably lossless: if any witness
+        places a read-only op elsewhere, moving it to the current legal
+        point yields another witness (the op preserves state), so eager
+        commits never foreclose anything and are NOT choice points.
+      * LAZY mutations: a state-changing op commits only at its own
+        FORCE, or when a forced op needs its effect.
+      * CHOICE POINTS: every FORCE of a mutator is a decision — commit
+        it directly (when legal), or commit some older pending op first
+        and re-try. The pure greedy took the first option and aborted
+        on any dead end; this certifier snapshots (pos, state, done)
+        per decision and, on a dead end, restores the most recent
+        snapshot with untried options — up to ``budget`` flips
+        (`JGRAFT_GREEDY_BACKTRACK`), after which it returns undecided.
+      * VALUE-GUIDED ordering: candidate commits are ranked by whether
+        they can expose a state the blocked op observes (the
+        enable/observe bitmask intersection above, confirmed by a
+        1-step lookahead; pure lookahead for models without the hooks
+        — this is what places a crashed queue landmine ENQ_ANY/DEQ_ANY
+        lazily at the first state where it unblocks a forced op), then
+        will-be-forced ops before optional crashed ops (known outcomes
+        before poison), then open order.
+
+    Soundness is unchanged from PR 9: True is returned only when a
+    complete legal witness respecting every [OPEN, FORCE] interval was
+    built, so True is a sound VALID for whatever rung produced the
+    stream; False/undecided NEVER refutes — callers fall through to the
+    exact kernel ladder (doc/checker-design.md §15)."""
     state = model.init_state()
     step = model.step
     readonly = frozenset(getattr(model, "readonly_fcodes", ()) or ())
+    if budget is None:
+        budget = _effective_budget(greedy_backtrack_budget(),
+                                   enc.n_events)
     events = enc.events.tolist()
-    # Per-open forced-ness: does this open's slot see a FORCE before the
-    # slot is reused? (Packing recycles a slot only at its FORCE, so the
-    # next event on the slot answers directly.)
-    next_on_slot: dict = {}
-    forced_open = [False] * len(events)
-    for pos in range(len(events) - 1, -1, -1):
+    n_ev = len(events)
+
+    # -- pre-decode: flat op table + per-event (etype, op id) ----------
+    ops: List[tuple] = []          # (f, a, b) per op, in open order
+    op_forced: List[bool] = []     # will this op's slot see a FORCE?
+    ev_ops: List[tuple] = []       # (etype, op id) per event position
+    active: dict = {}
+    for pos in range(n_ev):
         et, slot = events[pos][0], events[pos][1]
         if et == EV_OPEN:
-            forced_open[pos] = next_on_slot.get(slot) == EV_FORCE
-        next_on_slot[slot] = et
+            k = len(ops)
+            ops.append((events[pos][2], events[pos][3], events[pos][4]))
+            op_forced.append(False)
+            active[slot] = k
+            ev_ops.append((EV_OPEN, k))
+        elif et == EV_FORCE:
+            k = active.pop(slot)
+            op_forced[k] = True
+            ev_ops.append((EV_FORCE, k))
+        else:
+            ev_ops.append((0, -1))
+    opened_by = [0] * (n_ev + 1)   # #ops opened among events[:pos]
+    for pos in range(n_ev):
+        opened_by[pos + 1] = opened_by[pos] + (
+            1 if ev_ops[pos][0] == EV_OPEN else 0)
+    guide = _value_guide_masks(model, ops, op_forced)
 
-    # op record: [f, a, b, done, will_be_forced]
-    pending: List[list] = []
-    by_slot: dict = {}
-
-    def sweep():
+    def sweep(state, done, pending):
         # One pass suffices: read-only commits leave the state (the
         # only legality input) unchanged.
-        for o in pending:
-            if not o[3] and o[0] in readonly and \
-                    step(state, o[0], o[1], o[2])[1]:
-                o[3] = True
+        for k in pending:
+            if not (done >> k) & 1 and ops[k][0] in readonly \
+                    and step(state, *ops[k])[1]:
+                done |= 1 << k
+        return done
 
-    for pos, row in enumerate(events):
-        et, slot = row[0], row[1]
+    def candidates(state, done, pending, e):
+        """Ordered commit options at op e's FORCE. None = commit e
+        directly (listed first when legal — the greedy choice);
+        otherwise an older pending op id, value-guided order."""
+        te = ops[e]
+        s_e, legal_e = step(state, *te)
+        out = []
+        if legal_e:
+            out.append((-1, 0, 0, -1, None))
+        for k in pending:
+            if (done >> k) & 1 or k == e:
+                continue
+            s2, legal = step(state, *ops[k])
+            if not legal:
+                continue
+            if guide is not None and not (guide[0][k] & guide[1][e]):
+                enables = 1  # mask proves k exposes nothing e observes
+            else:
+                enables = 0 if step(s2, *te)[1] else 1
+            out.append((0, enables, 0 if op_forced[k] else 1, k, k))
+        out.sort(key=lambda t: t[:4])
+        return [t[4] for t in out]
+
+    flips = 0
+    # choice points: [pos, state, done, candidates|None (lazy), next].
+    # A None candidate list is computed only on first restore — the
+    # never-backtracked common path (every valid unambiguous row) pays
+    # one direct step() per FORCE exactly like the PR-9 scan, not a
+    # full candidate enumeration.
+    stack: deque = deque(maxlen=_BACKTRACK_STACK_CAP)
+    pending: List[int] = []
+    pos, done = 0, 0
+    while pos < n_ev:
+        et, k = ev_ops[pos]
         if et == EV_OPEN:
-            f, a, b = row[2], row[3], row[4]
-            e = [f, a, b, False, forced_open[pos]]
-            by_slot[slot] = e
+            f, a, b = ops[k]
             # Eager-commit at open when read-only and already legal
             # (the rest of `pending` was swept at this same state).
             if f in readonly and step(state, f, a, b)[1]:
-                e[3] = True
+                done |= 1 << k
             else:
-                pending.append(e)
-        elif et == EV_FORCE:
-            e = by_slot.pop(slot)
-            if e[3]:
-                continue
-            s2, legal = step(state, e[0], e[1], e[2])
-            if legal:
-                state = s2
-                e[3] = True
-                sweep()
+                pending.append(k)
+            pos += 1
+            continue
+        if et != EV_FORCE or (done >> k) & 1:
+            pos += 1
+            continue
+        s_k, legal_k = step(state, *ops[k])
+        choice = None
+        if legal_k:
+            # greedy direct commit; alternatives resolve lazily
+            if budget > 0 and any(not (done >> o) & 1 for o in pending):
+                stack.append([pos, state, done, None, 1])
+        else:
+            cands = candidates(state, done, pending, k)
+            if cands:
+                if len(cands) > 1 and budget > 0:
+                    stack.append([pos, state, done, cands, 1])
+                choice = cands[0]
             else:
-                # Commit older pending ops (open order, forced tier
-                # first) whose step is legal, re-trying the forced op
-                # after each commit.
-                while not e[3]:
-                    progressed = False
-                    for tier in (True, False):
-                        for o in pending:
-                            if o is e or o[3] or o[4] is not tier:
-                                continue
-                            s2, legal = step(state, o[0], o[1], o[2])
-                            if not legal:
-                                continue
-                            state = s2
-                            o[3] = True
-                            progressed = True
-                            sweep()
-                            s3, l3 = step(state, e[0], e[1], e[2])
-                            if l3:
-                                state = s3
-                                e[3] = True
-                                sweep()
-                            break
-                        if progressed:
-                            break
-                    if not progressed:
-                        return False  # undecided — kernel decides
-            pending = [o for o in pending if not o[3]]
-    return True
+                # dead end: restore the most recent choice point with
+                # an untried option (one restore = one flip)
+                while stack:
+                    cp = stack[-1]
+                    if cp[3] is None:  # lazy: enumerate at its state
+                        kc = ev_ops[cp[0]][1]
+                        pc = [o for o in range(opened_by[cp[0]])
+                              if not (cp[2] >> o) & 1]
+                        cp[3] = candidates(cp[1], cp[2], pc, kc)
+                    if cp[4] < len(cp[3]):
+                        flips += 1
+                        if flips > budget:
+                            return False, None, flips
+                        pos, state, done = cp[0], cp[1], cp[2]
+                        choice = cp[3][cp[4]]
+                        cp[4] += 1
+                        k = ev_ops[pos][1]
+                        pending = [o for o in range(opened_by[pos])
+                                   if not (done >> o) & 1]
+                        break
+                    stack.pop()
+                else:
+                    return False, None, flips  # undecided — kernels
+        commit = k if choice is None else choice
+        state = step(state, *ops[commit])[0]
+        done = sweep(state, done | (1 << commit), pending)
+        if choice is None:
+            pos += 1
+        # else: stay at pos — re-evaluate k's FORCE at the new state
+        pending = [o for o in pending if not (done >> o) & 1]
+    return True, ("greedy" if flips == 0 else "backtrack"), flips
+
+
+def greedy_certify(enc: EncodedHistory, model,
+                   budget: Optional[int] = None) -> bool:
+    """Boolean view of :func:`certify_encoded` (the historical PR-9
+    entry; True = sound VALID witness built, False = undecided)."""
+    return certify_encoded(enc, model, budget=budget)[0]
 
 
 # ------------------------------------------------------------ batch entry
 
 
 def apply_rung(encs: Sequence[EncodedHistory], model, consistency: str):
-    """Certify/relax a batch at `consistency`. Returns (out, certified):
-    `certified[i]` True where a greedy witness already proves the row
-    VALID at the rung (then `out[i]` is whichever encoding certified
-    it); otherwise `out[i]` is the rung-relaxed encoding for the
-    ordinary kernel ladder.
+    """Certify/relax a batch at `consistency`. Returns (out, certified,
+    tiers): `certified[i]` True where a witness already proves the row
+    VALID at the rung (then `out[i]` is whichever encoding certified it
+    and `tiers[i]` is "greedy" or "backtrack" — the decided-tier
+    attribution); otherwise `out[i]` is the rung-relaxed encoding for
+    the ordinary kernel ladder and `tiers[i]` is None.
 
     Certification order exploits monotone relaxation: a witness for the
     ORIGINAL (linearizable) stream is a witness for every weaker rung,
     and the original stream's FORCE order — real completion order, an
     approximation of the linearization order — is exactly the guidance
-    the greedy scan needs, so it succeeds there on most valid
-    histories and the row never pays the relaxation pass at all. Rows
-    it misses relax and retry (the relaxed stream admits rung-only
-    witnesses, e.g. stale reads); rows still undecided go to the
-    kernels on the relaxed stream."""
+    the certifier needs, so it succeeds there on most valid histories
+    and the row never pays the relaxation pass at all. Rows it misses
+    relax and retry (the relaxed stream admits rung-only witnesses,
+    e.g. stale reads); rows still undecided go to the kernels on the
+    relaxed stream."""
     consistency = normalize_consistency(consistency)
     n = len(encs)
     out: list = list(encs)
     certified = [False] * n
+    tiers: list = [None] * n
     greedy = greedy_on()
     for i, e in enumerate(encs):
-        if greedy and e.n_events > 0 and greedy_certify(e, model):
-            certified[i] = True
-            continue
+        if greedy and e.n_events > 0:
+            ok, tier, _ = certify_encoded(e, model)
+            if ok:
+                certified[i] = True
+                tiers[i] = tier
+                continue
         out[i] = relax_encoded(e, model, consistency)
-        if greedy and out[i].n_events > 0 and \
-                greedy_certify(out[i], model):
-            certified[i] = True
-    return out, certified
+        if greedy and out[i].n_events > 0:
+            ok, tier, _ = certify_encoded(out[i], model)
+            if ok:
+                certified[i] = True
+                tiers[i] = tier
+    return out, certified, tiers

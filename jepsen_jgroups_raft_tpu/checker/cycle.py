@@ -1,0 +1,312 @@
+"""Exact dependency-cycle refutation tier (ISSUE 13).
+
+The weaker-consistency rungs (checker/consistency.py) are interval-order
+*relaxations*: a rung PASS certifies its guarantee, but a rung FAIL only
+ever certifies non-linearizability — it is conservative about the
+guarantee itself (exact SC checking is NP-hard; the relaxed intervals
+still carry stream-order edges). This module adds the missing *exact
+refutation* direction, the same machinery PAPER.md's checker ecosystem
+reaches for in Elle: build the dependency graph whose edges every
+sequentially-consistent execution must respect, and a cycle in it is a
+sharp, witness-carrying proof that NO sequential order exists — not
+merely that none fits the relaxed intervals.
+
+Graph construction (register-shaped, via ``Model.rw_classify`` — the
+hook's contract is last-writer-wins state, models/base.py):
+
+  * **Required ops** — forced (ok-completed) ops always linearize.  An
+    optional (crashed) op is pulled in only when it is the UNIQUE
+    writer of a value some required reader observed — then it must have
+    linearized too (nobody else could have produced the value), fixed-
+    pointed across chains of optional CASes.  All other optional ops
+    are excluded: an edge through an op that might not linearize proves
+    nothing.
+  * **SO** (session order): consecutive required ops of one process, in
+    open order — program order binds every op that linearizes.
+  * **WR** (reads-from): reader r observed v (≠ the initial value) and
+    exactly ONE op w in the whole encoded history writes v ⇒ w → r.
+    Values written more than once contribute no WR edges (conservative,
+    never unsound).
+  * **RW** (anti-dependency): r reads v from unique writer w, and w' is
+    a required writer whose order after w is KNOWN (same process as w,
+    later open) ⇒ r → w' — r must precede the overwrite, else the only
+    writer of v sits before w' and the state at r could not be v.
+  * **Reads-of-initial**: r observed the initial value and NO op writes
+    it ⇒ r → every required writer (any write destroys the initial
+    value for good).
+
+Soundness (doc/checker-design.md §15): each edge u → v holds in every
+legal sequential execution of the required ops, and any witness the
+sequential rung's kernel accepts IS such an execution — so a cycle
+implies the rung kernel must answer INVALID too (composed verdicts
+stay exact; the cheap certifier only ever certifies VALID, this tier
+only ever refutes).  At the *session* rung the implemented guarantee
+(monotonic reads + read-your-writes) does NOT imply full session order
+— a monotonic-writes violation can pass the rung — so there the cycle
+is attached as an ``sc-refuted`` annotation instead of a verdict: exact
+evidence the history is not sequentially consistent even though the
+weaker rung honestly holds (the sharper-than-relaxation acceptance
+row, pinned in tests/test_cycle.py).
+
+Execution: adjacency matrices batch across rows (pow2-bucketed node
+counts, zero-padded rows), and the transitive closure is the batched
+int32 boolean-matmul squaring kernel of ops/kernel_ir.make_cycle_closure
+— free where matmul is free.  Off-TPU the same adjacency runs a host
+DFS instead (verdict-identical; the PLATFORM_ROUTE idiom —
+``JGRAFT_CYCLE_KERNEL`` forces either arm for tests/ablation).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..history.packing import EV_FORCE, EV_OPEN, EncodedHistory
+from ..ops.kernel_ir import CYCLE_MAX_NODES
+from ..platform import env_int
+
+
+def cycle_tier_on() -> bool:
+    """Whether the exact cycle tier runs at the weak rungs
+    (JGRAFT_CYCLE_TIER=0 disables — the ablation arm; verdicts must be
+    identical either way at the sequential rung, pinned by tests)."""
+    return env_int("JGRAFT_CYCLE_TIER", 1, minimum=0) != 0
+
+
+def cycle_max_ops() -> int:
+    """Per-row node cap (JGRAFT_CYCLE_MAX_OPS, default
+    CYCLE_MAX_NODES): rows whose required-op graph is bigger skip the
+    tier — the kernel ladder still decides them, so the cap only moves
+    work, never answers."""
+    return env_int("JGRAFT_CYCLE_MAX_OPS", CYCLE_MAX_NODES, minimum=1)
+
+
+def _use_kernel() -> bool:
+    """Closure-kernel routing: the batched matmul pass where matmul is
+    effectively free (TPU), the O(V+E) host DFS everywhere else — same
+    measured-routing stance as PLATFORM_ROUTE_MIN_CELLS.
+    JGRAFT_CYCLE_KERNEL=1/0 forces the arm (tests, ablation)."""
+    forced = os.environ.get("JGRAFT_CYCLE_KERNEL")
+    if forced is not None:
+        return forced == "1"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+# ------------------------------------------------------ graph building
+
+
+def build_sc_graph(enc: EncodedHistory, model) -> Optional[dict]:
+    """Dependency graph of one encoded history, or None when the model
+    cannot classify an op / the encoding has no per-event process ids /
+    the required-op count exceeds the cap.  Returns {"n", "adj"
+    ([n, n] uint8), "op_index" (node → original history op index)}."""
+    classify = getattr(model, "rw_classify", None)
+    if classify is None or enc.proc is None or enc.n_events == 0:
+        return None
+    events = enc.events
+    ops: List[tuple] = []   # (f, a, b, pid, hist_index)
+    forced: List[bool] = []
+    active: dict = {}
+    for pos in range(enc.n_events):
+        et, slot = int(events[pos, 0]), int(events[pos, 1])
+        if et == EV_OPEN:
+            active[slot] = len(ops)
+            ops.append((int(events[pos, 2]), int(events[pos, 3]),
+                        int(events[pos, 4]), int(enc.proc[pos]),
+                        int(enc.op_index[pos])))
+            forced.append(False)
+        elif et == EV_FORCE:
+            forced[active.pop(slot)] = True
+    cls: List[tuple] = []
+    for f, a, b, _pid, _hi in ops:
+        c = classify(f, a, b)
+        if c is None:
+            return None  # one unclassifiable op poisons every edge
+        cls.append(c)
+
+    def read_of(k):
+        c = cls[k]
+        return c[1] if c[0] in ("r", "rw") else None
+
+    def write_of(k):
+        c = cls[k]
+        return c[2] if c[0] == "rw" else (c[1] if c[0] == "w" else None)
+
+    initial = model.init_state()
+    writers: dict = {}
+    for k in range(len(ops)):
+        wv = write_of(k)
+        if wv is not None:
+            writers.setdefault(wv, []).append(k)
+
+    # required = forced ∪ (unique writers of required-observed values),
+    # to a fixpoint across optional CAS chains
+    required = {k for k in range(len(ops)) if forced[k]}
+    wr_edges = set()
+    frontier = list(required)
+    while frontier:
+        nxt = []
+        for r in frontier:
+            rv = read_of(r)
+            if rv is None or rv == initial:
+                continue
+            ws = writers.get(rv, [])
+            if len(ws) == 1 and ws[0] != r:
+                w = ws[0]
+                wr_edges.add((w, r))
+                if w not in required:
+                    required.add(w)
+                    nxt.append(w)
+        frontier = nxt
+    if len(required) > cycle_max_ops():
+        return None
+
+    order = sorted(required)               # open order
+    node = {k: i for i, k in enumerate(order)}
+    n = len(order)
+    adj = np.zeros((n, n), dtype=np.uint8)
+    # SO: consecutive required ops per process
+    last_of: dict = {}
+    for k in order:
+        pid = ops[k][3]
+        if pid in last_of:
+            adj[node[last_of[pid]], node[k]] = 1
+        last_of[pid] = k
+    req_writers = [k for k in order if write_of(k) is not None]
+    for w, r in wr_edges:
+        adj[node[w], node[r]] = 1
+        # RW: r must precede every overwrite whose order after w is
+        # known (same process as w, opened later)
+        for w2 in req_writers:
+            if w2 != w and w2 != r and ops[w2][3] == ops[w][3] \
+                    and w2 > w:
+                adj[node[r], node[w2]] = 1
+    # reads-of-initial: no op writes the initial value ⇒ the reader
+    # precedes every required writer
+    if not writers.get(initial):
+        for r in order:
+            if read_of(r) == initial:
+                for w2 in req_writers:
+                    if w2 != r:
+                        adj[node[r], node[w2]] = 1
+    np.fill_diagonal(adj, 0)
+    return {"n": n, "adj": adj,
+            "op_index": [ops[k][4] for k in order]}
+
+
+# ------------------------------------------------------ cycle detection
+
+
+def host_has_cycle(adj: np.ndarray) -> bool:
+    """Iterative 3-color DFS over a dense adjacency matrix — the
+    NetworkX-free host oracle the closure kernel is differentially
+    pinned against (and the off-TPU production arm)."""
+    n = int(adj.shape[0])
+    color = np.zeros(n, dtype=np.int8)  # 0 white, 1 gray, 2 black
+    succ = [np.flatnonzero(adj[i]) for i in range(n)]
+    for root in range(n):
+        if color[root]:
+            continue
+        stack = [(root, 0)]
+        color[root] = 1
+        while stack:
+            v, j = stack[-1]
+            if j < len(succ[v]):
+                stack[-1] = (v, j + 1)
+                w = int(succ[v][j])
+                if color[w] == 1:
+                    return True
+                if color[w] == 0:
+                    color[w] = 1
+                    stack.append((w, 0))
+            else:
+                color[v] = 2
+                stack.pop()
+    return False
+
+
+def cycle_witness(adj: np.ndarray) -> Optional[List[int]]:
+    """One concrete cycle (node list, closed implicitly) from a cyclic
+    adjacency matrix: shortest cycle through the first node that can
+    reach itself (BFS) — small, checkable evidence for the result
+    record."""
+    n = int(adj.shape[0])
+    for start in range(n):
+        # BFS from start's successors back to start
+        prev = np.full(n, -1, dtype=np.int64)
+        q = []
+        for s in np.flatnonzero(adj[start]):
+            prev[int(s)] = start
+            q.append(int(s))
+        qi = 0
+        while qi < len(q):
+            v = q[qi]
+            qi += 1
+            if adj[v, start]:
+                # path start → ... → v (→ start implicitly)
+                path = [v]
+                while path[-1] != start:
+                    path.append(int(prev[path[-1]]))
+                path.reverse()
+                return path
+            for w in np.flatnonzero(adj[v]):
+                w = int(w)
+                if prev[w] < 0:
+                    prev[w] = v
+                    q.append(w)
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def _closure_kernel(n_nodes: int):
+    from ..ops.kernel_ir import make_cycle_closure
+
+    return make_cycle_closure(n_nodes)
+
+
+def find_cycles(encs: Sequence[EncodedHistory], model,
+                kernel: Optional[bool] = None
+                ) -> List[Optional[dict]]:
+    """Per row: None (no graph / acyclic) or {"cycle": [history op
+    indices...], "nodes": n} — an exact SC refutation witness.  Graphs
+    batch by pow2-bucketed node count through the closure kernel on
+    TPU; host DFS otherwise (identical answers, pinned by tests).
+    `kernel` overrides the routing (False = host DFS even on TPU —
+    graftd's device-degrade path must not launch)."""
+    from ..history.packing import bucket_rows
+
+    out: List[Optional[dict]] = [None] * len(encs)
+    built = []
+    for i, enc in enumerate(encs):
+        g = build_sc_graph(enc, model)
+        if g is not None and g["n"] >= 2:
+            built.append((i, g))
+    if not built:
+        return out
+    flags = {}
+    if _use_kernel() if kernel is None else kernel:
+        by_bucket: dict = {}
+        for i, g in built:
+            by_bucket.setdefault(bucket_rows(g["n"], 4), []).append((i, g))
+        for N, rows in by_bucket.items():
+            batch = np.zeros((len(rows), N, N), dtype=np.int32)
+            for j, (_i, g) in enumerate(rows):
+                batch[j, :g["n"], :g["n"]] = g["adj"]
+            has, _closed = _closure_kernel(N)(batch)
+            has = np.asarray(has)  # lint: allow(host-sync)
+            for j, (i, _g) in enumerate(rows):
+                flags[i] = bool(has[j])
+    else:
+        for i, g in built:
+            flags[i] = host_has_cycle(g["adj"])
+    for i, g in built:
+        if flags.get(i):
+            path = cycle_witness(g["adj"]) or []
+            out[i] = {"cycle": [g["op_index"][v] for v in path],
+                      "nodes": g["n"]}
+    return out

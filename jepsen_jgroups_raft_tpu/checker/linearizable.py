@@ -58,8 +58,8 @@ from ..platform import degraded_note, env_int
 from . import autotune
 from .base import Checker, INVALID, UNKNOWN, VALID
 from .dfs_cpu import SearchBudgetExceeded, check_encoded_dfs
-from .schedule import (ChunkLaunch, build_dense_launches, run_chunked,
-                       scan_chunk)
+from .schedule import (ChunkLaunch, build_dense_launches, note_tier,
+                       run_chunked, scan_chunk)
 from .wgl_cpu import FrontierOverflow, check_encoded_cpu
 
 
@@ -195,18 +195,56 @@ def check_encoded(
     if consistency != "linearizable":
         from .consistency import apply_rung
 
-        relaxed, certified = apply_rung(encs, model, consistency)
+        t0 = time.perf_counter()
+        relaxed, certified, tiers = apply_rung(encs, model, consistency)
+        dt_cert = time.perf_counter() - t0
         results: list[Optional[dict]] = [None] * len(encs)
         todo: list[int] = []
+        # Wall attribution: each certified row carries its FAIR share
+        # of the whole certify+relax pass (dt_cert / batch rows) — the
+        # undecided rows' share stays unattributed here on purpose
+        # (their verdict cost is the kernel tier's wall); booking the
+        # full batch wall onto the few certified rows would inflate
+        # the cheap tier's reported cost arbitrarily.
+        per_row = dt_cert / max(len(encs), 1)
         for i, (enc, ok) in enumerate(zip(relaxed, certified)):
             if ok:
                 results[i] = {
                     "valid?": VALID, "algorithm": "greedy-witness",
                     "op-count": enc.n_ops,
                     "concurrency-window": enc.n_slots,
+                    "decided-tier": tiers[i],
                 }
+                note_tier(tiers[i], wall_s=per_row)
             else:
                 todo.append(i)
+        # Exact cycle tier (ISSUE 13, checker/cycle.py): a dependency
+        # cycle among the required ops is a sharp SC refutation, and
+        # at the sequential rung it implies the kernel verdict INVALID
+        # (doc §15) — so undecided rows consult it BEFORE paying the
+        # relax + kernel-ladder pass. The tier only ever refutes;
+        # cycle-free rows fall through unchanged.
+        if todo and consistency == "sequential":
+            from .cycle import cycle_tier_on, find_cycles
+
+            if cycle_tier_on():
+                t0 = time.perf_counter()
+                cyc = find_cycles([encs[i] for i in todo], model)
+                dt_cyc = time.perf_counter() - t0
+                hits = [(j, i) for j, i in enumerate(todo)
+                        if cyc[j] is not None]
+                for j, i in hits:
+                    results[i] = {
+                        "valid?": INVALID, "algorithm": "cycle",
+                        "op-count": encs[i].n_ops,
+                        "concurrency-window": encs[i].n_slots,
+                        "decided-tier": "cycle",
+                        "cycle": cyc[j]["cycle"],
+                        "exact-sc-refutation": True,
+                    }
+                    note_tier("cycle", wall_s=dt_cyc / len(hits))
+                if hits:
+                    todo = [i for i in todo if results[i] is None]
         if todo:
             sub = check_encoded([relaxed[i] for i in todo], model,
                                 algorithm, n_configs, n_slots, witness,
@@ -214,6 +252,8 @@ def check_encoded(
                                 consistency="linearizable")
             for i, r in zip(todo, sub):
                 results[i] = r
+        if consistency == "session":
+            _annotate_sc_refutations(encs, results, model)
         for r in results:
             r["consistency"] = consistency
         return results  # type: ignore[return-value]
@@ -249,6 +289,30 @@ def _normalize_rung(name) -> str:
     from .consistency import normalize_consistency
 
     return normalize_consistency(name)
+
+
+def _annotate_sc_refutations(encs, results, model) -> None:
+    """Session-rung SC evidence (ISSUE 13): the implemented session
+    guarantee (monotonic reads + read-your-writes) does NOT imply full
+    sequential consistency — a monotonic-writes violation can honestly
+    PASS the rung — so a dependency cycle here is attached as an
+    annotation, never a verdict change: ``sc-refuted`` marks results
+    whose history is exactly proven non-SC even though the weaker rung
+    holds (the sharper-than-relaxation acceptance evidence, pinned in
+    tests/test_cycle.py). Best-effort and ablation-gated like the
+    verdict tier."""
+    from .cycle import cycle_tier_on, find_cycles
+
+    if not cycle_tier_on():
+        return
+    try:
+        cyc = find_cycles(encs, model)
+    except Exception:
+        return  # evidence must never take down a sound verdict
+    for r, c in zip(results, cyc):
+        if c is not None and r is not None:
+            r["sc-refuted"] = True
+            r["sc-cycle"] = c["cycle"]
 
 
 def _check_encoded(
@@ -359,7 +423,8 @@ def _check_encoded(
     return results  # type: ignore[return-value]
 
 
-def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
+def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None,
+              note: bool = True):
     """Run the on-device pass over a batch of encoded histories. Returns a
     result dict per history, or None where the kernel could not certify a
     verdict (window beyond MAX_SLOTS, or frontier overflow at top
@@ -372,8 +437,10 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             if e.n_slots <= cap and e.n_events > 0]
     for i, e in enumerate(encs):
         if e.n_events == 0:
+            if note:
+                note_tier("trivial")
             results[i] = {"valid?": VALID, "algorithm": "trivial",
-                          "op-count": 0}
+                          "op-count": 0, "decided-tier": "trivial"}
     # Resolved before any routing: the group loop below rebinds `kernel`
     # to the compiled callable, and the segment router must also honor
     # an explicit pallas request (an ablation asking for pallas must not
@@ -415,7 +482,8 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             for j, i in enumerate(long_idx):
                 if seg[j] is not None:
                     r = _jx(VALID if seg[j]["valid"] else INVALID, encs[i],
-                            dt / max(n_done, 1), kernel="dense-seg")
+                            dt / max(n_done, 1), kernel="dense-seg",
+                            note=note)
                     r["segments"] = seg[j]["segments"]
                     results[i] = r
             fits = [i for i in fits if results[i] is None]
@@ -475,7 +543,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                 dt = out.wall_s / max(len(sub), 1)
                 for j, i in enumerate(sub):
                     r = _jx(VALID if out.ok[j] else INVALID, encs[i],
-                            dt, kernel=out.tag)
+                            dt, kernel=out.tag, note=note)
                     r["chunked"] = True
                     results[i] = r
         elif grouped:
@@ -565,7 +633,7 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
                     for j, i in enumerate(sub):
                         results[i] = _jx(VALID if ok[j] else INVALID,
                                          encs[i], dt / max(len(sub), 1),
-                                         kernel=tag)
+                                         kernel=tag, note=note)
         # Histories beyond the dense caps continue to the sort ladder.
         fits = [fits[j] for j in rest]
     if fits:
@@ -638,9 +706,11 @@ def _jax_pass(encs, model, n_configs=None, n_slots=None, kernel=None):
             escalate = []
             for j, i in enumerate(remaining):
                 if ok[j]:
-                    results[i] = _jx(VALID, encs[i], dt / len(remaining))
+                    results[i] = _jx(VALID, encs[i], dt / len(remaining),
+                                     note=note)
                 elif not overflow[j]:
-                    results[i] = _jx(INVALID, encs[i], dt / len(remaining))
+                    results[i] = _jx(INVALID, encs[i],
+                                     dt / len(remaining), note=note)
                 elif rung + 1 < len(ladder):
                     escalate.append(i)
                 # else: overflowed at top capacity → undecided (None)
@@ -687,10 +757,16 @@ def _race(encs, model, n_configs, n_slots, witness, max_cpu_configs):
             if decided[i] is None:
                 res["raced"] = True
                 decided[i] = res
+                # Tier attribution belongs to the WINNER only — the
+                # losing engine's work on the same row must not double-
+                # count rows (fractions would exceed 1.0).
+                tier = res.get("decided-tier")
+                if tier is not None:
+                    note_tier(tier, wall_s=res.get("time-s", 0.0))
 
     def jax_side():
         try:
-            rs = _jax_pass(encs, model, n_configs, n_slots)
+            rs = _jax_pass(encs, model, n_configs, n_slots, note=False)
         except Exception:
             # The DFS side carries the race — but never silently: an
             # always-failing kernel (model bug, shape regression) would
@@ -712,7 +788,7 @@ def _race(encs, model, n_configs, n_slots, witness, max_cpu_configs):
                 if decided[i] is not None:
                     continue
             r = _check_dfs(encs[i], model, witness,
-                           max_steps=DEFAULT_DFS_BUDGET)
+                           max_steps=DEFAULT_DFS_BUDGET, note=False)
             if r["valid?"] is not UNKNOWN:
                 record(i, r)
 
@@ -729,20 +805,27 @@ def _race(encs, model, n_configs, n_slots, witness, max_cpu_configs):
 
 
 def _check_dfs(enc: EncodedHistory, model, witness: bool = False,
-               max_steps: Optional[int] = None) -> dict:
+               max_steps: Optional[int] = None, note: bool = True) -> dict:
     if enc.n_events == 0:
-        return {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
+        if note:
+            note_tier("trivial")
+        return {"valid?": VALID, "algorithm": "trivial", "op-count": 0,
+                "decided-tier": "trivial"}
+    t0 = time.perf_counter()
     try:
         r = check_encoded_dfs(enc, model, max_steps=max_steps,
                               witness=witness)
     except SearchBudgetExceeded as e:
         return {"valid?": UNKNOWN, "algorithm": "dfs", "error": str(e)}
+    if note:
+        note_tier("host", wall_s=time.perf_counter() - t0)
     out = {
         "valid?": VALID if r.valid else INVALID,
         "algorithm": "dfs",
         "op-count": enc.n_ops,
         "concurrency-window": enc.n_slots,
         "configs-explored": r.configs_explored,
+        "decided-tier": "host",
     }
     if not r.valid:
         out["failing-op-index"] = r.failing_op_index
@@ -762,8 +845,23 @@ def _maybe_profile():
 
 
 
+def kernel_tier(tag: str) -> str:
+    """Decided-tier name of a kernel tag (ISSUE 13 attribution): the
+    mask kernel is its own (cheapest) tier, every other dense-family
+    kernel (domain / pallas / segmented) reports "dense", the sort
+    ladder "sort"."""
+    if "mask" in tag:
+        return "mask"
+    if "sort" in tag:
+        return "sort"
+    return "dense"
+
+
 def _jx(valid, enc: EncodedHistory, secs: float,
-        kernel: str = "sort") -> dict:
+        kernel: str = "sort", note: bool = True) -> dict:
+    tier = kernel_tier(kernel)
+    if note:
+        note_tier(tier, wall_s=secs)
     return {
         "valid?": valid,
         "algorithm": "jax",
@@ -771,6 +869,7 @@ def _jx(valid, enc: EncodedHistory, secs: float,
         "op-count": enc.n_ops,
         "concurrency-window": enc.n_slots,
         "time-s": secs,
+        "decided-tier": tier,
     }
 
 
@@ -786,34 +885,80 @@ def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
     A weaker ``consistency`` rung relaxes/greedy-certifies exactly like
     `check_encoded`, so degraded rung verdicts match the device path."""
     if enc.n_events == 0:
-        return {"valid?": VALID, "algorithm": "trivial", "op-count": 0}
+        note_tier("trivial")
+        return {"valid?": VALID, "algorithm": "trivial", "op-count": 0,
+                "decided-tier": "trivial"}
     consistency = _normalize_rung(consistency)
     if consistency != "linearizable":
         from .consistency import apply_rung
 
-        [enc], [certified] = apply_rung([enc], model, consistency)
+        orig = enc
+
+        def annotate_session(res: dict) -> dict:
+            # Same sc-refuted evidence the device path attaches to
+            # EVERY session-rung result (certified ones included) —
+            # the degrade path must not silently drop it. Host DFS
+            # arm: no device launch; best-effort like the device twin.
+            from .cycle import cycle_tier_on, find_cycles
+
+            if consistency == "session" and cycle_tier_on():
+                try:
+                    [c] = find_cycles([orig], model, kernel=False)
+                except Exception:
+                    c = None
+                if c is not None:
+                    res["sc-refuted"] = True
+                    res["sc-cycle"] = c["cycle"]
+            return res
+
+        [enc], [certified], [tier] = apply_rung([enc], model, consistency)
         if certified:
-            return {"valid?": VALID, "algorithm": "greedy-witness",
-                    "op-count": enc.n_ops,
-                    "concurrency-window": enc.n_slots,
-                    "consistency": consistency}
+            note_tier(tier)
+            return annotate_session(
+                {"valid?": VALID, "algorithm": "greedy-witness",
+                 "op-count": enc.n_ops,
+                 "concurrency-window": enc.n_slots,
+                 "decided-tier": tier,
+                 "consistency": consistency})
+        if consistency == "sequential":
+            # Exact cycle tier on the degrade path too (host DFS arm:
+            # no device launch) — same verdict the frontier would
+            # reach, decided without the search.
+            from .cycle import cycle_tier_on, find_cycles
+
+            if cycle_tier_on():
+                [c] = find_cycles([orig], model, kernel=False)
+                if c is not None:
+                    note_tier("cycle")
+                    return {"valid?": INVALID, "algorithm": "cycle",
+                            "op-count": orig.n_ops,
+                            "concurrency-window": orig.n_slots,
+                            "decided-tier": "cycle",
+                            "cycle": c["cycle"],
+                            "exact-sc-refutation": True,
+                            "consistency": consistency}
     r = _check_cpu(enc, model, witness, max_cpu_configs)
     if r.get("valid?") is UNKNOWN:
         r2 = _check_dfs(enc, model, witness, max_steps=DEFAULT_DFS_BUDGET)
         if r2["valid?"] is not UNKNOWN:
             r = r2
     if consistency != "linearizable":
+        r = annotate_session(r)
         r["consistency"] = consistency
     return r
 
 
 def _check_cpu(enc: EncodedHistory, model, witness: bool,
-               max_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS) -> dict:
+               max_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
+               note: bool = True) -> dict:
+    t0 = time.perf_counter()
     try:
         r = check_encoded_cpu(enc, model, max_configs=max_configs,
                               witness=witness)
     except FrontierOverflow as e:
         return {"valid?": UNKNOWN, "algorithm": "cpu", "error": str(e)}
+    if note:
+        note_tier("host", wall_s=time.perf_counter() - t0)
     out = {
         "valid?": VALID if r.valid else INVALID,
         "algorithm": "cpu",
@@ -821,6 +966,7 @@ def _check_cpu(enc: EncodedHistory, model, witness: bool,
         "concurrency-window": enc.n_slots,
         "configs-explored": r.configs_explored,
         "max-frontier": r.max_frontier,
+        "decided-tier": "host",
     }
     if not r.valid:
         out["failing-op-index"] = r.failing_op_index

@@ -93,6 +93,14 @@ class PerfChecker(Checker):
         tune = autotune_summary()
         if tune is not None:
             out["autotune"] = tune
+        # Tier attribution (ISSUE 13): which decision-ladder tier
+        # decided this run's verdicts, with per-tier wall time — at
+        # fleet scale the cheap-tier decided fraction IS the capacity
+        # model, so the per-run store carries it next to the scan
+        # counters.
+        tiers = tier_summary()
+        if tiers is not None:
+            out["decided-tiers"] = tiers
         store_dir = (test or {}).get("store_dir")
         if self.render and store_dir:
             try:
@@ -147,6 +155,32 @@ def autotune_summary():
     return {"plans-loaded": c["plans_loaded"],
             "plans-measured": c["plans_measured"],
             "plan-misses": c["plan_misses"]}
+
+
+def format_tier_stats(tiers: dict):
+    """Result-dict form of a raw per-tier counter dict ({tier: {"rows",
+    "wall_s"}}), or None when nothing was decided. Reports decided row
+    counts, the decided FRACTION per tier (the fleet capacity metric),
+    and per-tier wall seconds."""
+    total = sum(v["rows"] for v in tiers.values())
+    if not total:
+        return None
+    return {
+        "decided-rows": {k: v["rows"] for k, v in tiers.items()},
+        "decided-fraction": {k: round(v["rows"] / total, 4)
+                             for k, v in tiers.items()},
+        "wall-s": {k: round(v["wall_s"], 4) for k, v in tiers.items()},
+    }
+
+
+def tier_summary():
+    """Per-run tier-attribution counters (checker/schedule.note_tier),
+    or None when nothing was decided. Scoped like
+    `scan_stats_summary` — the innermost active `stats_scope` wins, so
+    back-to-back runs store their own fractions."""
+    from .schedule import snapshot_tiers
+
+    return format_tier_stats(snapshot_tiers(scoped=True))
 
 
 #: fault-op f → healing-op f (the start/stop convention nemesis packages

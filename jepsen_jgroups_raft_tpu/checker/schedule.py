@@ -180,6 +180,65 @@ def consume_stats() -> dict:
         return out
 
 
+# ------------------------------------------------------ tier attribution
+# ISSUE 13: every verdict records which tier of the decision ladder
+# decided it (greedy / backtrack / cycle / mask / dense / sort / host /
+# trivial). At fleet scale the cheap-tier hit-rate IS the capacity
+# model, so the per-tier decided counts and wall time are first-class
+# counters next to the chunked-scan stats: same process-wide totals +
+# thread-affine scope attribution, surfaced by checker/perf.py,
+# bench.py rows, and graftd's per-request stats.
+
+_TIERS: dict = {}  # tier -> [rows, wall_s]; guarded by _STATS_LOCK
+
+
+def note_tier(tier: str, rows: int = 1, wall_s: float = 0.0) -> None:
+    """Record `rows` verdicts decided by `tier` (and the wall seconds
+    attributed to them). Scope targeting mirrors `_add_stats`: a thread
+    owning scopes feeds only its own (each scope's tier dict lives
+    under the non-counter key ``"tiers"``)."""
+    tid = threading.get_ident()
+    with _STATS_LOCK:
+        t = _TIERS.setdefault(tier, [0, 0.0])
+        t[0] += rows
+        t[1] += wall_s
+        owned = [s for s, o in _SCOPES if o == tid]
+        targets = owned if owned else [s for s, _ in _SCOPES]
+        for scope in targets:
+            e = scope.setdefault("tiers", {}).setdefault(tier, [0, 0.0])
+            e[0] += rows
+            e[1] += wall_s
+
+
+def _format_tiers(raw: dict) -> dict:
+    return {k: {"rows": v[0], "wall_s": v[1]} for k, v in raw.items()}
+
+
+def snapshot_tiers(scoped: bool = False) -> dict:
+    """Copy of the per-tier decided counters, ``{tier: {"rows",
+    "wall_s"}}`` (non-destructive). `scoped=True` reads the innermost
+    scope owned by this thread, like `snapshot_stats`."""
+    with _STATS_LOCK:
+        if scoped and _SCOPES:
+            tid = threading.get_ident()
+            for s, o in reversed(_SCOPES):
+                if o == tid:
+                    return _format_tiers(s.get("tiers", {}))
+            return _format_tiers(_SCOPES[-1][0].get("tiers", {}))
+        return _format_tiers(_TIERS)
+
+
+def consume_tiers() -> dict:
+    """Return and reset the process-wide per-tier counters (bench.py
+    reads one timed window's worth at a time); active scopes keep
+    their own accumulations, like `consume_stats`."""
+    global _TIERS
+    with _STATS_LOCK:
+        out = _format_tiers(_TIERS)
+        _TIERS = {}
+        return out
+
+
 # ------------------------------------------------------------- wavefront
 
 

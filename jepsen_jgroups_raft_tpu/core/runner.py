@@ -401,7 +401,7 @@ def run_test(test: dict) -> dict:
     test["history"] = history
     checker = test.get("checker")
     if checker is not None:
-        from ..checker.perf import format_scan_stats
+        from ..checker.perf import format_scan_stats, format_tier_stats
         from ..checker.schedule import stats_scope
 
         LOG.info("checking %d-op history", len(history))
@@ -415,6 +415,14 @@ def run_test(test: dict) -> dict:
         scan = format_scan_stats(scan_scope)
         if scan is not None and isinstance(test["results"], dict):
             test["results"].setdefault("scan-stats", scan)
+        # ISSUE 13: the run's per-tier decided counts ride beside the
+        # scan counters (same scope, same authoritative-after-the-
+        # composed-check stance).
+        tiers = format_tier_stats(
+            {k: {"rows": v[0], "wall_s": v[1]}
+             for k, v in scan_scope.get("tiers", {}).items()})
+        if tiers is not None and isinstance(test["results"], dict):
+            test["results"].setdefault("decided-tiers", tiers)
     else:
         test["results"] = {"valid?": True, "note": "no checker"}
     if live_result is not None and isinstance(test["results"], dict):

@@ -168,6 +168,25 @@ def _ir_chunk_budget(interp: Interp) -> List[str]:
     # still fit next to the carry at the caps. MACRO_MAX_OPENS comes
     # from history/packing.py via the sibling-constant merge; a cap
     # bump that outgrows the proven bindings surfaces here, loudly.
+    # Cycle-closure adjacency slab (ISSUE 13): the batched transitive-
+    # closure kernel keeps the int32 adjacency matrix and its squared
+    # product resident per row — proven at the CYCLE_MAX_NODES cap so
+    # a cap bump fails the gate until the accounting is re-proven.
+    fn_cy = interp.functions.get("cycle_adjacency_bytes")
+    cap_n = interp.module_env.get("CYCLE_MAX_NODES")
+    if fn_cy is None or not isinstance(cap_n, int):
+        out.append(("kernel-unresolved",
+                    "cycle_adjacency_bytes / CYCLE_MAX_NODES "
+                    "not resolvable"))
+    else:
+        for N in (2, cap_n):
+            n = interp.exec_fn(fn_cy, {"n_nodes": N})
+            if not isinstance(n, int):
+                out.append(("kernel-unresolved",
+                            f"cycle_adjacency_bytes({N}) not evaluable"))
+            elif n > 16 << 20:
+                out.append(f"cycle adjacency slab at N={N} = {n} B "
+                           "exceeds usable per-core VMEM")
     fn_r = interp.functions.get("macro_row_ints")
     cap_p = interp.module_env.get("MACRO_MAX_OPENS")
     if fn_r is None or not isinstance(cap_p, int):
@@ -252,6 +271,10 @@ CONTRACTS: Dict[str, Contract] = {
         ("SORT_DEFAULT_CONFIGS * ((SORT_MAX_SLOTS // 32 + 1) * 4 + 4)",
          16 << 20,
          "sort frontier at the default capacity exceeds VMEM"),
+        # ISSUE 13: the cycle-closure adjacency + product slab at the
+        # node cap (the custom binding also executes the accounting fn).
+        ("2 * CYCLE_MAX_NODES * CYCLE_MAX_NODES * 4", 16 << 20,
+         "cycle adjacency slab at the node cap exceeds VMEM"),
     ], custom=_ir_chunk_budget),
     "ops/dense_scan.py": Contract(const_asserts=[
         # Re-assert the caps through dense_scan's own import site: the

@@ -176,6 +176,21 @@ class Model:
         'nobody observes v downstream' proof to hold)."""
         return None
 
+    def rw_classify(self, f: int, a: int, b: int):
+        """Dependency-graph role of op (f, a, b) for the exact cycle
+        tier (checker/cycle.py): ``("r", v)`` reads value v, ``("w",
+        v)`` writes value v, ``("rw", rv, wv)`` reads rv then writes wv
+        (a CAS), or None — the model cannot classify this op and the
+        whole history skips the cycle tier (conservative: the tier only
+        ever refutes, so skipping is always sound).
+
+        Contract: only meaningful for last-writer-wins models whose
+        state IS the most recently written value (a read of v is legal
+        iff the latest preceding write wrote v). The cycle tier's
+        writes-before / anti-dependency edge derivations assume exactly
+        that; a model violating it must return None."""
+        return None
+
     def _encode(self, pair: OpPair) -> Optional[EncodedOp]:
         raise NotImplementedError
 

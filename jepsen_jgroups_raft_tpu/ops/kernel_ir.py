@@ -84,6 +84,12 @@ MASK_DENSE_MAX_SLOTS = 12
 SORT_MAX_SLOTS = 127
 SORT_DEFAULT_CONFIGS = 256
 
+#: Cycle-tier cap (ISSUE 13): dependency graphs beyond this many nodes
+#: skip the exact refutation tier (the kernel ladder still decides
+#: them). The adjacency slab at this cap is proven against the VMEM
+#: budget by the kernel-contract analyzer (cycle_adjacency_bytes).
+CYCLE_MAX_NODES = 512
+
 
 def scan_unroll() -> int:
     """Events per lax.scan step across the event-scan kernels (dense,
@@ -342,6 +348,53 @@ def shard_chunk_fns(init_fn, step_fn, mesh, n_init_args: int):
     return init_sm, step_sm
 
 
+# ------------------------------------------------------- cycle closure
+
+
+def make_cycle_closure(n_nodes: int):
+    """Batched boolean transitive-closure kernel for the exact cycle
+    tier (checker/cycle.py, ISSUE 13): ``closure(adj)`` with adj
+    [B, N, N] int32 0/1 adjacency matrices (pow2-bucketed N, rows
+    padded with zero matrices) returns (has_cycle [B] bool,
+    closed [B, N, N]).
+
+    The whole pass is repeated boolean matrix squaring — ``R ← R ∨
+    R·R`` — as one batched int32 einsum inside a `lax.while_loop`:
+    after k squarings R holds every path of length ≤ 2^k, so
+    ceil(log2 N) iterations reach the full transitive closure (the
+    loop also exits early when a squaring changes nothing); a set
+    diagonal bit then witnesses a cycle. This is exactly the encoded
+    substrate's shape — int32 matmul batched over independent rows —
+    which is why the tier is essentially free where matmul is free
+    (the MXU); off-TPU the caller routes to a host DFS on the same
+    adjacency instead (checker/cycle.py, the PLATFORM_ROUTE idiom).
+    Entries stay in {0, 1} (re-binarized every iteration), so the
+    int32 row sums are bounded by N ≤ CYCLE_MAX_NODES — no overflow.
+    """
+    n = int(n_nodes)
+    n_iter = max(1, (max(n, 2) - 1).bit_length())
+
+    def closure(adj):
+        def cond(c):
+            i, _, changed = c
+            return changed & (i < n_iter)
+
+        def body(c):
+            i, a, _ = c
+            prod = jnp.einsum("bij,bjk->bik", a, a,
+                              preferred_element_type=jnp.int32)
+            nxt = jnp.minimum(a + jnp.minimum(prod, 1), 1)
+            return (i + 1, nxt, jnp.any(nxt != a))
+
+        _, closed, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), adj.astype(jnp.int32),
+                         jnp.bool_(True)))
+        diag = jnp.diagonal(closed, axis1=1, axis2=2)
+        return jnp.any(diag > 0, axis=1), closed
+
+    return jax.jit(closure)
+
+
 # ----------------------------------------------------- contract bindings
 # Conservative per-row resident bytes of each family's chunked carry.
 # Pure arithmetic on purpose: the graftcheck kernel-contract analyzer
@@ -368,3 +421,12 @@ def sort_chunk_carry_bytes(n_configs: int, n_slots: int) -> int:
     return (n_configs * k * 4 + n_configs * 4   # masks + states
             + 3 * n_slots * 4 + n_slots         # slot regs + open
             + 8)                                # ok/overflow/dirty/left
+
+
+def cycle_adjacency_bytes(n_nodes: int) -> int:
+    """Per-row resident bytes of the cycle-closure kernel: the int32
+    adjacency/closure matrix plus the squared-product buffer the einsum
+    materializes (two [N, N] int32 slabs live across the while_loop
+    body). Executed statically at CYCLE_MAX_NODES by the
+    kernel-contract analyzer (lint/flow/kernel_contract.py)."""
+    return 2 * n_nodes * n_nodes * 4

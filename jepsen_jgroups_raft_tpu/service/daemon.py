@@ -222,6 +222,11 @@ class CheckingService:
             "store_hits": 0, "store_puts": 0,
             "handoff_claims": 0, "handoff_requests": 0,
         }
+        #: ISSUE 13: daemon-wide decided-tier counters ({tier: rows}
+        #: over every demuxed verdict) — the fleet capacity-model
+        #: metric, merged per batch and served by /stats. Kept outside
+        #: _stats so _count's int arithmetic never sees a dict.
+        self._tier_counts: dict = {}
         self._service_time_s = 1.0  # EWMA of per-request service time
         # Cluster tier (ISSUE 11): constructed only when a cluster dir
         # is configured — the single-replica daemon never imports the
@@ -979,6 +984,7 @@ class CheckingService:
     def stats(self) -> dict:
         with self._lock:
             out = dict(self._stats)
+            out["decided_tier"] = dict(self._tier_counts)
             lat = list(self._latencies)
         out["queue_depth"] = self.queue.depth
         out["cache_entries"] = len(self.cache)
@@ -1100,6 +1106,9 @@ class CheckingService:
             self._stats["batched_requests"] += info["requests"]
             if info["degraded"]:
                 self._stats["degraded_batches"] += 1
+            for tier, n in info.get("tiers", {}).items():
+                self._tier_counts[tier] = \
+                    self._tier_counts.get(tier, 0) + n
         self._account_requests(batch)
 
     def _account_requests(self, batch) -> None:

@@ -272,7 +272,7 @@ class BatchScheduler:
                 live.append(r)
         if not live:
             return {"requests": 0, "rows": 0, "degraded": False,
-                    "wall_s": 0.0}
+                    "wall_s": 0.0, "tiers": {}}
         with self._seq_lock:
             self._seq += 1
             seq = self._seq
@@ -339,13 +339,25 @@ class BatchScheduler:
                 for res in results:
                     res["platform-degraded"] = degraded_note_local
         wall = time.monotonic() - t0
-        scan_counters = {k: v for k, v in scan.items() if k != "label"}
+        scan_counters = {k: v for k, v in scan.items()
+                         if k not in ("label", "tiers")}
         autotune_plans = autotune.applied_since(
             autotune_mark, thread_id=threading.get_ident())
+        batch_tiers: dict = {}
         cursor = 0
         for r in live:
             mine = results[cursor:cursor + r.n_rows]
             cursor += r.n_rows
+            # Tier attribution (ISSUE 13): which decision-ladder tier
+            # decided each of this request's rows — the per-request
+            # trace record's capacity-model evidence, aggregated
+            # daemon-wide into /stats decided_tier.
+            tiers: dict = {}
+            for res in mine:
+                t = res.get("decided-tier") if res else None
+                if t is not None:
+                    tiers[t] = tiers.get(t, 0) + 1
+                    batch_tiers[t] = batch_tiers.get(t, 0) + 1
             r.stats = {
                 "batched_requests": len(live),
                 "batch_rows": len(encs),
@@ -353,6 +365,7 @@ class BatchScheduler:
                 "batch_wall_s": round(wall, 4),
                 "scan": dict(scan_counters, label=label),
                 "autotune_plans": autotune_plans,
+                "decided_tier": tiers,
                 "placement": dict(placement) if placement else
                 {"shard": 0, "n_shards": 1},
                 "degraded": degraded_note_local is not None,
@@ -366,7 +379,7 @@ class BatchScheduler:
                 r.finish(DONE, results=mine)
         return {"requests": len(live), "rows": len(encs),
                 "degraded": degraded_note_local is not None,
-                "wall_s": wall, "seq": seq}
+                "wall_s": wall, "seq": seq, "tiers": batch_tiers}
 
     #: Skip counterexample minimization for units beyond this many ops:
     #: the greedy pair-drop is bounded anyway (counterexample.py caps),

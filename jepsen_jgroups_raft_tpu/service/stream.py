@@ -198,6 +198,7 @@ class StreamUnit:
         self.ops_total = 0
         self.greedy = True            # greedy fast path still carries
         self.certified = False        # greedy proved the settled prefix
+        self.certify_tier = None      # "greedy"/"backtrack" (ISSUE 13)
         self.scan: Optional[CarriedScan] = None
         self.spilled = False
         self.escalated = False        # needs the full ladder at finish
@@ -406,10 +407,17 @@ class StreamSession:
             if unit.spilled or unit.enc.n_events > greedy_max_events():
                 unit.greedy = False
             else:
-                from ..checker.consistency import greedy_certify
+                # ISSUE 13: the value-guided bounded-backtrack
+                # certifier — mutator-ambiguous register segments that
+                # PR-9 greedy handed to the carried kernel now certify
+                # per segment (tier recorded for the final verdict).
+                from ..checker.consistency import certify_encoded
 
-                if greedy_certify(unit.settled_encoding(), self.model):
+                ok, tier, _ = certify_encoded(
+                    unit.settled_encoding(), self.model)
+                if ok:
                     unit.certified = True
+                    unit.certify_tier = tier
                     return
                 unit.greedy = False
                 unit.certified = False
@@ -486,12 +494,16 @@ class StreamSession:
         """The certain-violation record (the frozen ``~ok ∧ ~overflow``
         pair), with a minimized counterexample when the op budget
         allows — ONE construction for the mid-run and finish paths."""
+        from ..checker.schedule import note_tier
+
+        note_tier("sort")
         res = {
             "valid?": INVALID,
             "algorithm": "jax-stream",
             "kernel": "sort-stream",
             "op-count": unit.enc.n_ops,
             "concurrency-window": unit.enc.n_slots,
+            "decided-tier": "sort",
             "decided-at-segment": seq,
         }
         if unit.ops and unit.ops_total <= MAX_COUNTEREXAMPLE_OPS:
@@ -555,12 +567,17 @@ class StreamSession:
             unit.ingest([], final=True)
         if unit.greedy and not unit.spilled \
                 and unit.enc.n_events <= greedy_max_events():
-            from ..checker.consistency import greedy_certify
+            from ..checker.consistency import certify_encoded
+            from ..checker.schedule import note_tier
 
-            if greedy_certify(unit.settled_encoding(), self.model):
+            ok, tier, _ = certify_encoded(unit.settled_encoding(),
+                                          self.model)
+            if ok:
+                note_tier(tier)
                 return {"valid?": VALID, "algorithm": "greedy-witness",
                         "op-count": unit.enc.n_ops,
-                        "concurrency-window": unit.enc.n_slots}
+                        "concurrency-window": unit.enc.n_slots,
+                        "decided-tier": tier}
         unit.greedy = False
         if not unit.escalated:
             # final=True: a spilled unit's WAL rebuild must apply the
@@ -571,10 +588,14 @@ class StreamSession:
                 unit.drain_pending()
             if not unit.escalated and unit.scan is not None:
                 if unit.scan.ok:
+                    from ..checker.schedule import note_tier
+
+                    note_tier("sort")
                     return {"valid?": VALID, "algorithm": "jax-stream",
                             "kernel": "sort-stream",
                             "op-count": unit.enc.n_ops,
-                            "concurrency-window": unit.enc.n_slots}
+                            "concurrency-window": unit.enc.n_slots,
+                            "decided-tier": "sort"}
                 if not unit.scan.overflow:
                     return self._invalid_result(unit, self.segments)
                 unit.escalated = True
@@ -619,6 +640,8 @@ class StreamSession:
             d["status"] = "escalated"
         elif unit.greedy:
             d["status"] = "certified" if unit.certified else "streaming"
+            if unit.certified and unit.certify_tier:
+                d["decided-tier"] = unit.certify_tier
         else:
             d["status"] = "streaming"
             if unit.scan is not None:

@@ -10,8 +10,8 @@ import random
 import pytest
 
 from jepsen_jgroups_raft_tpu.checker.consistency import (
-    CONSISTENCY_LEVELS, greedy_certify, normalize_consistency,
-    relax_encoded, rung_index)
+    CONSISTENCY_LEVELS, certify_encoded, greedy_certify,
+    normalize_consistency, relax_encoded, rung_index)
 from jepsen_jgroups_raft_tpu.checker.linearizable import (
     check_encoded_host, check_histories)
 from jepsen_jgroups_raft_tpu.checker.wgl_cpu import check_encoded_cpu
@@ -185,6 +185,134 @@ def test_greedy_ablation_verdicts_identical(monkeypatch):
            check_histories(hists, m, consistency="sequential")]
     assert on == off
     assert False in on and True in on
+
+
+# ------------------------------- bounded-backtrack certifier (ISSUE 13)
+
+
+def test_backtrack_certifies_ambiguous_registers():
+    """The PR-9 boundary: cas-register mutator ambiguity defeats the
+    no-backtrack greedy. The value-guided backtracking certifier must
+    decide most of the same seeded family — with budget 0 (the PR-9
+    ablation arm) it must not, pinning backtracking as the mechanism."""
+    rng = random.Random(3)
+    m = CasRegister()
+    encs = [encode_history(
+        random_valid_history(rng, "register", n_ops=200, n_procs=5,
+                             crash_p=0.05, max_crashes=3), m)
+        for _ in range(30)]
+    full = [certify_encoded(e, m) for e in encs]
+    none = [certify_encoded(e, m, budget=0) for e in encs]
+    n_full = sum(1 for ok, _, _ in full if ok)
+    n_none = sum(1 for ok, _, _ in none if ok)
+    assert n_full >= 27, n_full          # ≥90% of the seeded family
+    assert n_none < n_full               # backtracking IS the win
+    assert any(t == "backtrack" for ok, t, _ in full if ok)
+    # tier naming: a zero-flip certification reports "greedy"
+    for ok, tier, flips in full:
+        if ok:
+            assert tier == ("greedy" if flips == 0 else "backtrack")
+
+
+def test_queue_landmine_certification():
+    """Crashed ENQ/DEQ landmines: the certifier places deferred
+    optional obligations lazily at the first state where they unblock
+    a forced op — the seeded queue family must certify ≥ 0.9 (the
+    ISSUE-13 acceptance fraction), including a hand-built landmine
+    shape that needs TWO optional commits to unblock a forced DEQ."""
+    from jepsen_jgroups_raft_tpu.models.queuemodel import TicketQueue
+
+    m = TicketQueue()
+    # enq t0 ok; two crashed enqueues (tickets 1, 2 unknown); a crashed
+    # dequeue; then a forced DEQ observing ticket 2: head must advance
+    # 0→2 via the crashed deq AND the landmine enqueues must have
+    # landed tickets 1 and 2 first.
+    landmine = H(
+        (0, "invoke", "enqueue", None), (0, "ok", "enqueue", 0),
+        (1, "invoke", "enqueue", None), (1, "info", "enqueue", None),
+        (2, "invoke", "enqueue", None), (2, "info", "enqueue", None),
+        (3, "invoke", "dequeue", None), (3, "info", "dequeue", None),
+        (4, "invoke", "dequeue", None), (4, "ok", "dequeue", 1),
+    )
+    enc = encode_history(landmine, m)
+    ok, _tier, _ = certify_encoded(enc, m)
+    assert ok
+    assert check_encoded_cpu(enc, m).valid  # and the oracle agrees
+    rng = random.Random(17)
+    encs = [encode_history(
+        random_valid_history(rng, "queue", n_ops=200, n_procs=5,
+                             crash_p=0.05, max_crashes=3), m)
+        for _ in range(30)]
+    frac = sum(1 for e in encs if certify_encoded(e, m)[0]) / len(encs)
+    assert frac >= 0.9, frac
+
+
+def test_backtrack_certifier_is_sound_on_adversarial_histories():
+    """certify True ⇒ the CPU oracle agrees VALID — exercised through
+    the backtracking paths (corrupted histories force dead ends), both
+    on the original and the rung-relaxed streams."""
+    rng = random.Random(23)
+    exercised = 0
+    for kind, factory in MODELS.items():
+        model = factory()
+        for i in range(12):
+            h = random_valid_history(rng, kind, n_ops=16, crash_p=0.2)
+            if i % 2:
+                h = corrupt(rng, h)
+            for enc in (encode_history(h, model),
+                        relax_encoded(encode_history(h, model), model,
+                                      "sequential")):
+                ok, tier, flips = certify_encoded(enc, model)
+                if ok:
+                    exercised += 1
+                    assert check_encoded_cpu(enc, model).valid, (kind, i)
+    assert exercised > 20
+
+
+def test_certifier_differential_matrix_macro_on_off(monkeypatch):
+    """Full-path differential: cheap tier on/off × macro on/off over
+    register+queue at the sequential rung — verdicts bitwise-identical
+    in every cell, both polarities present."""
+    rng = random.Random(43)
+    cases = []
+    for kind in ("register", "queue"):
+        for i in range(8):
+            h = random_valid_history(rng, kind, n_ops=14, n_procs=3,
+                                     crash_p=0.1)
+            if i % 4 == 0:
+                h = corrupt(rng, h)
+            cases.append((kind, h))
+
+    def verdicts():
+        return [check_histories([h], MODELS[kind](),
+                                consistency="sequential")[0]["valid?"]
+                for kind, h in cases]
+
+    grid = {}
+    for macro in ("1", "0"):
+        monkeypatch.setenv("JGRAFT_MACRO_EVENTS", macro)
+        for cheap in ("1", "0"):
+            monkeypatch.setenv("JGRAFT_GREEDY_CERTIFY", cheap)
+            monkeypatch.setenv("JGRAFT_CYCLE_TIER", cheap)
+            grid[(macro, cheap)] = verdicts()
+    cells = list(grid.values())
+    assert all(c == cells[0] for c in cells), grid
+    assert True in cells[0] and False in cells[0]
+
+
+def test_certified_results_carry_decided_tier():
+    rng = random.Random(3)
+    m = CasRegister()
+    hists = [random_valid_history(rng, "register", n_ops=120, n_procs=5,
+                                  crash_p=0.05, max_crashes=3)
+             for _ in range(12)]
+    rs = check_histories(hists, m, consistency="sequential")
+    tiers = {r.get("decided-tier") for r in rs}
+    assert None not in tiers            # every verdict attributes a tier
+    assert tiers & {"greedy", "backtrack"}
+    for r in rs:
+        if r["algorithm"] == "greedy-witness":
+            assert r["decided-tier"] in ("greedy", "backtrack")
 
 
 def test_check_encoded_host_supports_rungs():

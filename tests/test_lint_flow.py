@@ -191,12 +191,24 @@ class TestKernelContract:
         found = kc(mutated, path="ops/kernel_ir.py")
         assert "kernel-vmem-budget" in rules_of(found)
 
+    def test_cycle_adjacency_contract_fires_on_inflated_slab(self):
+        # ISSUE-13 binding: the cycle-closure kernel keeps the int32
+        # adjacency + product slab resident per row; inflating the
+        # accounting past VMEM at CYCLE_MAX_NODES must fail the gate.
+        text = (PKG / "ops" / "kernel_ir.py").read_text()
+        assert "2 * n_nodes * n_nodes * 4" in text
+        mutated = text.replace("2 * n_nodes * n_nodes * 4",
+                               "2 * n_nodes * n_nodes * 4096")
+        found = kc(mutated, path="ops/kernel_ir.py")
+        assert "kernel-vmem-budget" in rules_of(found)
+
     def test_chunk_carry_binding_is_loud_when_fn_vanishes(self):
         # Renaming the accounting fn must FAIL the gate (loud), not
         # silently drop the chunked-carry invariant — for BOTH families'
-        # accounting in the IR.
+        # accounting in the IR (and the ISSUE-13 cycle slab's).
         text = (PKG / "ops" / "kernel_ir.py").read_text()
-        for fn in ("dense_chunk_carry_bytes", "sort_chunk_carry_bytes"):
+        for fn in ("dense_chunk_carry_bytes", "sort_chunk_carry_bytes",
+                   "cycle_adjacency_bytes"):
             mutated = text.replace(f"def {fn}", "def renamed_carry_bytes")
             found = kc(mutated, path="ops/kernel_ir.py")
             # The loud path must surface under kernel-unresolved (NOT

@@ -129,6 +129,29 @@ class TestBatching:
         assert max(r.stats["batched_requests"] for r in reqs) >= 2
         assert svc.stats()["batches"] < 8  # strictly fewer launches
 
+    def test_decided_tier_counters_per_request_and_daemon(self):
+        """ISSUE 13: every demuxed verdict attributes a decision-ladder
+        tier — per-request stats (the trace record's capacity-model
+        evidence) and the daemon-wide /stats decided_tier counters are
+        both present and non-degenerate."""
+        svc = make_service()
+        try:
+            r = svc.submit([valid_hist(seed=5)], workload="register")
+            s = svc.submit([valid_hist(seed=6)], workload="register",
+                           consistency="sequential")
+            assert r.wait(60) and s.wait(60)
+            assert sum(r.stats["decided_tier"].values()) == 1
+            assert sum(s.stats["decided_tier"].values()) == 1
+            # the weak-rung request decided on a cheap tier
+            assert set(s.stats["decided_tier"]) & \
+                {"greedy", "backtrack", "cycle"}
+            st = svc.stats()
+            assert sum(st["decided_tier"].values()) >= 2
+            assert r.results[0]["decided-tier"] in \
+                ("dense", "mask", "sort", "host", "trivial")
+        finally:
+            svc.shutdown(wait=True)
+
     def test_multi_history_requests_demux_by_row(self):
         a = [valid_hist(seed=1), invalid_hist(), valid_hist(seed=2)]
         b = [invalid_hist()]

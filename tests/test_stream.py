@@ -249,6 +249,50 @@ class TestStreamVerdictIdentity:
             fin, _ = _stream_whole(svc, h, "register", n_segments=5)
             assert fin["valid?"] is VALID
             assert fin["results"][0]["algorithm"] == "greedy-witness"
+            assert fin["results"][0]["decided-tier"] == "greedy"
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_backtracking_certifier_carries_ambiguous_sessions(
+            self, tmp_path, monkeypatch):
+        """ISSUE-13 stream-tier regression: a register session whose
+        mutator ambiguity defeats the PR-9 no-backtrack greedy
+        (JGRAFT_GREEDY_BACKTRACK=0 demonstrably hands it to the
+        carried kernel) now stays on the greedy fast path per segment
+        and finishes greedy-witness, with the deciding tier stamped."""
+        from jepsen_jgroups_raft_tpu.checker.consistency import \
+            certify_encoded
+
+        m = CasRegister()
+        rng = random.Random(3)
+        svc = _service(tmp_path)
+        try:
+            target = None
+            for _ in range(80):
+                h = random_valid_history(rng, "register", n_ops=60,
+                                         n_procs=5, crash_p=0.05,
+                                         max_crashes=3)
+                # the finish-time certify runs on the UNPRUNED settled
+                # stream; condition the search on that exact stream
+                enc = encode_history(h.client_ops(), m, prune=False)
+                if certify_encoded(enc, m, budget=0)[0]:
+                    continue
+                if not certify_encoded(enc, m)[0]:
+                    continue
+                fin, _ = _stream_whole(svc, h, "register", 4)
+                if fin["results"][0]["algorithm"] == "greedy-witness":
+                    target = h
+                    break
+            assert target is not None, "no ambiguous-but-certifiable seed"
+            assert fin["valid?"] is VALID
+            assert fin["results"][0]["decided-tier"] == "backtrack"
+            # PR-9 ablation arm: same session, backtracking off — the
+            # greedy path drops it and the carried kernel answers, with
+            # the SAME verdict (the wiring never changes verdicts).
+            monkeypatch.setenv("JGRAFT_GREEDY_BACKTRACK", "0")
+            fin2, _ = _stream_whole(svc, target, "register", 4)
+            assert fin2["valid?"] is VALID
+            assert fin2["results"][0]["algorithm"] != "greedy-witness"
         finally:
             svc.shutdown(wait=True)
 
