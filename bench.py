@@ -721,6 +721,67 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             "platform": jax.devices()[0].platform,
         })
 
+    if not dist_on and os.environ.get("JGRAFT_BENCH_LIN_FASTPATH",
+                                      "1") != "0":
+        # ISSUE-14 ablation row: the same batch at the LINEARIZABLE
+        # rung through the production check_encoded entry, fast path
+        # on vs force-disabled (JGRAFT_LIN_FASTPATH=0) in one process,
+        # verdicts asserted identical before the timing is trusted.
+        # Capped at 256 rows like the rung row; the acceptance A/B
+        # lives in scripts/ab_lin_fastpath.py.
+        from jepsen_jgroups_raft_tpu.checker.linearizable import (
+            check_encoded, consume_fastpath_counters)
+        from jepsen_jgroups_raft_tpu.checker.schedule import consume_tiers
+
+        sub = encs[:min(len(encs), 256)]
+        prior_fp = os.environ.get("JGRAFT_LIN_FASTPATH")
+        arms: dict = {}
+        try:
+            for arm in ("1", "0"):
+                os.environ["JGRAFT_LIN_FASTPATH"] = arm
+                check_encoded(sub, model, algorithm="jax")  # warm-up
+                beat()
+                consume_tiers()
+                consume_fastpath_counters()
+                t0 = time.perf_counter()
+                rs = check_encoded(sub, model, algorithm="jax")
+                arms[arm] = (time.perf_counter() - t0, rs,
+                             consume_tiers(),
+                             consume_fastpath_counters())
+        finally:
+            if prior_fp is None:
+                os.environ.pop("JGRAFT_LIN_FASTPATH", None)
+            else:
+                os.environ["JGRAFT_LIN_FASTPATH"] = prior_fp
+        dt_on, rs_on, tiers_on, fp = arms["1"]
+        dt_off, rs_off, _, _ = arms["0"]
+        identical = [a["valid?"] for a in rs_on] == \
+            [b["valid?"] for b in rs_off]
+        emit({
+            "metric": "lin_fastpath_hist_per_sec",
+            "value": round(len(sub) / dt_on, 2),
+            "unit": "hist/s",
+            "rows": len(sub),
+            "lin_fastpath_on_s": round(dt_on, 3),
+            "lin_fastpath_off_s": round(dt_off, 3),
+            "lin_fastpath_speedup": round(dt_off / max(dt_on, 1e-9), 3),
+            "lin_fastpath_certified_rows": fp["rows_certified"],
+            "lin_fastpath_scanned_rows": fp["rows_scanned"],
+            "lin_fastpath_gated_rows": fp["rows_gated"],
+            "lin_fastpath_rung_skipped_rows": fp["rows_rung_skipped"],
+            "lin_fastpath_certify_wall_s": round(
+                fp["certify_wall_s"], 4),
+            "lin_fastpath_verdicts_identical": identical,
+            "decided_by_tier": {k: v["rows"]
+                                for k, v in tiers_on.items()},
+            "tier_wall_s": {k: round(v["wall_s"], 4)
+                            for k, v in tiers_on.items()},
+            "platform": jax.devices()[0].platform,
+        })
+        if not identical:
+            fail("lin fastpath on/off verdicts diverge",
+                 platform_note=platform_note)
+
 
 def autotune_report() -> dict:
     """Bench-JSON summary of the autotuner's engagement this process:
@@ -979,12 +1040,16 @@ def run_service(platform_note: str) -> None:
     _CLEANUP.append(service.shutdown)
     _CLEANUP.append(rm_journal_tmp)
 
-    def wave():
+    def wave(pool=None, expect_valid=True):
         """One rep: n_requests submitted from n_clients threads, every
         verdict awaited. Returns (wall_s, latencies, rejected,
         stats_delta) — the daemon counters are snapshotted per wave so
         the emitted batches/cache numbers describe the SAME rep as
-        time_s/req_s, not an accumulation across all best_of reps."""
+        time_s/req_s, not an accumulation across all best_of reps.
+        `pool` overrides the request payloads (the ISSUE-14 fast-lane
+        A/B drives a mixed valid/invalid stream, where only the DONE
+        status is asserted, not the verdict)."""
+        pool = payloads if pool is None else pool
         s0 = service.stats()
         latencies: list = []
         rejected = [0]
@@ -1001,7 +1066,7 @@ def run_service(platform_note: str) -> None:
                 t0 = time.perf_counter()
                 while True:
                     try:
-                        rec = cl.submit(payloads[i], workload="register")
+                        rec = cl.submit(pool[i], workload="register")
                         break
                     except ServiceError as e:
                         if e.status != 429:
@@ -1013,7 +1078,8 @@ def run_service(platform_note: str) -> None:
                 while rec["status"] not in ("done", "failed", "cancelled"):
                     rec = cl.result(rec["id"], wait_s=60.0)
                 assert rec["status"] == "done", rec
-                assert rec["valid?"] is True, rec
+                if expect_valid:
+                    assert rec["valid?"] is True, rec
                 dt = time.perf_counter() - t0
                 with lock:
                     latencies.append(dt)
@@ -1035,6 +1101,67 @@ def run_service(platform_note: str) -> None:
     beat()
     (wall, latencies, rejected, delta), rep_times = best_of(wave)
     stats = service.stats()
+
+    # ISSUE-14 fast-lane A/B: a MIXED decided/undecided stream (odd
+    # requests corrupted → the certifier cannot decide them and they
+    # ride the kernel batch path; even requests are fast-lane
+    # certifiable), lane on vs JGRAFT_LIN_FASTPATH=0, interleaved in
+    # THIS process against the same daemon — the p99 claim is that
+    # certifiable requests stop queueing behind kernel launches.
+    fastlane_fields: dict = {}
+    if os.environ.get("JGRAFT_SERVICE_BENCH_FASTLANE", "1") != "0":
+        from jepsen_jgroups_raft_tpu.history.synth import corrupt
+
+        rng2 = _random.Random(20260804)
+        mixed = []
+        for i in range(n_requests):
+            hs = [random_valid_history(rng2, "register", n_ops=n_ops,
+                                       n_procs=5, crash_p=0.05,
+                                       max_crashes=3)
+                  for _ in range(n_hists)]
+            if i % 2 == 1:
+                hs = [corrupt(rng2, h) for h in hs]
+            mixed.append(hs)
+
+        def pct(xs, q):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+
+        def arm(on: bool):
+            os.environ["JGRAFT_LIN_FASTPATH"] = "1" if on else "0"
+            s0 = service.stats()["fastpath_requests"]
+            _, lat, _, _ = wave(pool=mixed, expect_valid=False)
+            return lat, service.stats()["fastpath_requests"] - s0
+
+        prior_fp = os.environ.get("JGRAFT_LIN_FASTPATH")
+        lat_ab: dict = {True: [], False: []}
+        fp_reqs = 0
+        try:
+            for on in (True, False):   # warm-up both arms' shapes
+                arm(on)
+            beat()
+            for rep in range(2):       # interleaved, order rotated
+                order = (True, False) if rep % 2 == 0 else (False, True)
+                for on in order:
+                    lat, d = arm(on)
+                    lat_ab[on].extend(lat)
+                    if on:
+                        fp_reqs += d
+        finally:
+            if prior_fp is None:
+                os.environ.pop("JGRAFT_LIN_FASTPATH", None)
+            else:
+                os.environ["JGRAFT_LIN_FASTPATH"] = prior_fp
+        fastlane_fields = {
+            "fastlane_p50_on_s": round(pct(lat_ab[True], 0.5), 4),
+            "fastlane_p99_on_s": round(pct(lat_ab[True], 0.99), 4),
+            "fastlane_p50_off_s": round(pct(lat_ab[False], 0.5), 4),
+            "fastlane_p99_off_s": round(pct(lat_ab[False], 0.99), 4),
+            "fastlane_p99_speedup": round(
+                pct(lat_ab[False], 0.99)
+                / max(pct(lat_ab[True], 0.99), 1e-9), 3),
+            "fastpath_requests": fp_reqs,
+        }
 
     httpd.shutdown()
     httpd.server_close()
@@ -1088,6 +1215,10 @@ def run_service(platform_note: str) -> None:
         # health counters): which decision-ladder tier decided the
         # daemon's demuxed verdicts.
         "decided_tier": stats["decided_tier"],
+        # ISSUE-14 fast-lane A/B over a mixed decided/undecided stream
+        # (lane on vs JGRAFT_LIN_FASTPATH=0, interleaved; empty when
+        # JGRAFT_SERVICE_BENCH_FASTLANE=0 skips the phase).
+        **fastlane_fields,
         # Same host-drift armor as the batch rows (ISSUE-4 satellites):
         # best rep + full spread + cold/warm split + host fingerprint.
         "rep_times_s": [round(t, 3) for t in rep_times],

@@ -56,7 +56,7 @@ import numpy as np
 from ..history.packing import (MACRO_MAX_OPENS, bucket_rows,
                                macro_events_on, pack_batch,
                                pack_macro_batch)
-from ..platform import env_int
+from ..platform import env_float, env_int
 
 _log = logging.getLogger(__name__)
 
@@ -278,6 +278,7 @@ def reset_for_tests() -> None:
     with _LOCK:
         _MEM.clear()
         _APPLIED.clear()
+        _LINFP_MEM.clear()
         for k in _COUNTERS:
             _COUNTERS[k] = 0
 
@@ -587,6 +588,139 @@ def tuned_sort_plan(model, encs: Sequence, n_configs: int,
         if c not in candidates:
             candidates.append(c)
     return resolve_plan(sig, candidates, measure)
+
+
+# ------------------------------------- lin fast-path gating (ISSUE 14)
+# The linearizable-rung pre-kernel certify pass (checker/linearizable
+# `lin_fastpath_pass`) has a measured worst case: a batch whose rows the
+# host certifier cannot decide pays the host scan AND the kernel. The
+# autotuner therefore grows a `lin_fastpath` dimension: per
+# (model family, event shape-bucket) it accumulates hit-rate and
+# marginal-wall samples — persisted in the SAME host-fingerprinted
+# store as the launch plans (a host change invalidates certify-speed
+# observations exactly like chunk timings) — and `lin_fastpath_route`
+# answers whether a bucket should try the host certifier first or go
+# kernel-first. Gating only ever affects ROUTING, never verdicts
+# (undecided rows always reach the kernels), and is part of the
+# measured autotuner: with JGRAFT_AUTOTUNE=0 the fast path always
+# tries (flag-only behavior, no host-state dependence — what the
+# deterministic test environment pins).
+
+#: lin-fastpath record schema version; unknown versions re-observe.
+LINFP_VERSION = 1
+
+_LINFP_MEM: dict = {}   # sig -> {"rows", "hits", "certify_wall_s"}
+
+
+def lin_fastpath_min_hit() -> float:
+    """Hit-rate floor below which a measured bucket routes kernel-first
+    (JGRAFT_LIN_FASTPATH_MIN_HIT, default 0.05 — the ~5% worst-case
+    overhead bound the acceptance A/B pins; defensive parse)."""
+    return env_float("JGRAFT_LIN_FASTPATH_MIN_HIT", 0.05, minimum=0.0)
+
+
+def lin_fastpath_min_obs() -> int:
+    """Rows a bucket must have been observed over before the hit-rate
+    gate may route it kernel-first (JGRAFT_LIN_FASTPATH_MIN_OBS,
+    default 64): trying IS measuring, so unknown buckets always try."""
+    return env_int("JGRAFT_LIN_FASTPATH_MIN_OBS", 64, minimum=1)
+
+
+def lin_fastpath_sig(family: str, n_events: int) -> tuple:
+    """Gating bucket: model family plus the pow2+midpoint event bucket
+    (the same floor-32 series the launch shapes pad to). Window/state
+    shape is deliberately absent — certify cost scales with E·W but the
+    hit-rate is a property of the WORKLOAD family, and fragmenting the
+    observations per window would starve the gate of samples."""
+    return ("linfp", str(family), bucket_rows(max(int(n_events), 1), 32))
+
+
+def _linfp_path(sig: tuple) -> Path:
+    return store_root() / host_fingerprint() / \
+        f"linfp-{sig[1]}-e{sig[2]}.json"
+
+
+def _linfp_record(sig: tuple) -> dict:
+    """The bucket's in-memory record, seeded from the fingerprint store
+    on first touch. Corrupt/stale/foreign files mean 'start fresh,
+    never silently mis-gate' — same stance as `plan_for`."""
+    with _LOCK:
+        rec = _LINFP_MEM.get(sig)
+        if rec is not None:
+            return rec
+    fresh = {"rows": 0, "hits": 0, "certify_wall_s": 0.0}
+    path = _linfp_path(sig)
+    try:
+        raw = json.loads(path.read_text())
+        if (raw.get("version") == LINFP_VERSION
+                and raw.get("fingerprint") == host_fingerprint()
+                and raw.get("signature") == list(sig)):
+            fresh = {"rows": int(raw["rows"]), "hits": int(raw["hits"]),
+                     "certify_wall_s": float(raw["certify_wall_s"])}
+        else:
+            _log.warning("autotune: stale lin-fastpath record %s — "
+                         "re-observing", path)
+    except FileNotFoundError:
+        pass
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError,
+            KeyError, TypeError, ValueError) as e:
+        _log.warning("autotune: unreadable lin-fastpath record %s "
+                     "(%s: %s) — re-observing", path, type(e).__name__, e)
+    with _LOCK:
+        rec = _LINFP_MEM.setdefault(sig, fresh)
+    return rec
+
+
+def lin_fastpath_route(sig: tuple) -> bool:
+    """True → run the host certifier first for this bucket; False →
+    the measured hit-rate says kernel-first. Routing only: a gated
+    bucket's rows take the ordinary kernel ladder unchanged."""
+    if not autotune_on():
+        return True
+    rec = _linfp_record(sig)
+    with _LOCK:
+        rows, hits = rec["rows"], rec["hits"]
+    if rows < lin_fastpath_min_obs():
+        return True
+    return hits / rows >= lin_fastpath_min_hit()
+
+
+def lin_fastpath_observe(sig: tuple, rows: int, hits: int,
+                         wall_s: float) -> None:
+    """Fold one batch's certify outcome into the bucket's record and
+    persist it (atomic tmp+rename, best-effort — a read-only store
+    degrades gating to in-memory, never checking)."""
+    if rows <= 0 or not autotune_on():
+        return
+    rec = _linfp_record(sig)
+    with _LOCK:
+        rec["rows"] += int(rows)
+        rec["hits"] += int(hits)
+        rec["certify_wall_s"] += float(wall_s)
+        payload = {
+            "version": LINFP_VERSION,
+            "fingerprint": host_fingerprint(),
+            "fingerprint_info": fingerprint_info(),
+            "signature": list(sig),
+            "rows": rec["rows"],
+            "hits": rec["hits"],
+            "certify_wall_s": round(rec["certify_wall_s"], 6),
+            # the marginal-wall sample an operator reads the gate by
+            "certify_wall_per_row_s": round(
+                rec["certify_wall_s"] / max(rec["rows"], 1), 6),
+            "hit_rate": round(rec["hits"] / max(rec["rows"], 1), 4),
+            "updated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+        }
+    path = _linfp_path(sig)
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(payload, indent=2))
+        os.replace(tmp, path)
+    except OSError as e:
+        _log.warning("autotune: could not persist lin-fastpath record "
+                     "%s (%s: %s)", path, type(e).__name__, e)
 
 
 def sort_rung_sharding(tuned: Optional[TunedPlan]):

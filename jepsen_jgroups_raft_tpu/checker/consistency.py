@@ -131,6 +131,11 @@ def greedy_backtrack_budget() -> int:
                    minimum=0)
 
 
+class _AbortBudget(Exception):
+    """Internal: the certifier's step-count abort budget ran out
+    (ISSUE 14). Converted to an undecided answer — never a verdict."""
+
+
 def _effective_budget(base: int, n_events: int) -> int:
     """Per-row budget: the base, scaled linearly past
     `_BUDGET_SCALE_EVENTS` events (64 at ≤256 events, ~448 at a
@@ -285,7 +290,8 @@ def _value_guide_masks(model, ops, forced):
 
 
 def certify_encoded(enc: EncodedHistory, model,
-                    budget: Optional[int] = None
+                    budget: Optional[int] = None,
+                    max_steps: Optional[int] = None
                     ) -> Tuple[bool, Optional[str], int]:
     """Witness construction on an encoded stream, with value-guided
     bounded backtracking (the ISSUE-13 widening of PR 9's one-pass
@@ -323,9 +329,30 @@ def certify_encoded(enc: EncodedHistory, model,
     complete legal witness respecting every [OPEN, FORCE] interval was
     built, so True is a sound VALID for whatever rung produced the
     stream; False/undecided NEVER refutes — callers fall through to the
-    exact kernel ladder (doc/checker-design.md §15)."""
+    exact kernel ladder (doc/checker-design.md §15).
+
+    ``max_steps`` (ISSUE 14): an ABORT budget on total `model.step`
+    calls. The flip budget bounds backtracking but not the scan's raw
+    candidate-enumeration work, so a hopeless row on the linearizable
+    fast path could otherwise cost an unbounded fraction of its kernel
+    wall; past the budget the row returns undecided (never wrong — the
+    kernels answer). None/0 = unbounded, today's exact behavior; the
+    lin fast path passes a length-scaled budget
+    (JGRAFT_LIN_FASTPATH_ABORT · events, checker/linearizable.py).
+
+    NOTE: `StreamingCertifier` below is this scan's resumable twin —
+    commit rules and candidate ordering are mirrored BY HAND (see its
+    lock-step contract note for why they are not unified)."""
     state = model.init_state()
     step = model.step
+    if max_steps is not None and max_steps > 0:
+        raw_step, left = step, [int(max_steps)]
+
+        def step(s, f, a, b):
+            left[0] -= 1
+            if left[0] < 0:
+                raise _AbortBudget()
+            return raw_step(s, f, a, b)
     readonly = frozenset(getattr(model, "readonly_fcodes", ()) or ())
     if budget is None:
         budget = _effective_budget(greedy_backtrack_budget(),
@@ -399,64 +426,68 @@ def certify_encoded(enc: EncodedHistory, model,
     stack: deque = deque(maxlen=_BACKTRACK_STACK_CAP)
     pending: List[int] = []
     pos, done = 0, 0
-    while pos < n_ev:
-        et, k = ev_ops[pos]
-        if et == EV_OPEN:
-            f, a, b = ops[k]
-            # Eager-commit at open when read-only and already legal
-            # (the rest of `pending` was swept at this same state).
-            if f in readonly and step(state, f, a, b)[1]:
-                done |= 1 << k
-            else:
-                pending.append(k)
-            pos += 1
-            continue
-        if et != EV_FORCE or (done >> k) & 1:
-            pos += 1
-            continue
-        s_k, legal_k = step(state, *ops[k])
-        choice = None
-        if legal_k:
-            # greedy direct commit; alternatives resolve lazily
-            if budget > 0 and any(not (done >> o) & 1 for o in pending):
-                stack.append([pos, state, done, None, 1])
-        else:
-            cands = candidates(state, done, pending, k)
-            if cands:
-                if len(cands) > 1 and budget > 0:
-                    stack.append([pos, state, done, cands, 1])
-                choice = cands[0]
-            else:
-                # dead end: restore the most recent choice point with
-                # an untried option (one restore = one flip)
-                while stack:
-                    cp = stack[-1]
-                    if cp[3] is None:  # lazy: enumerate at its state
-                        kc = ev_ops[cp[0]][1]
-                        pc = [o for o in range(opened_by[cp[0]])
-                              if not (cp[2] >> o) & 1]
-                        cp[3] = candidates(cp[1], cp[2], pc, kc)
-                    if cp[4] < len(cp[3]):
-                        flips += 1
-                        if flips > budget:
-                            return False, None, flips
-                        pos, state, done = cp[0], cp[1], cp[2]
-                        choice = cp[3][cp[4]]
-                        cp[4] += 1
-                        k = ev_ops[pos][1]
-                        pending = [o for o in range(opened_by[pos])
-                                   if not (done >> o) & 1]
-                        break
-                    stack.pop()
+    try:
+        while pos < n_ev:
+            et, k = ev_ops[pos]
+            if et == EV_OPEN:
+                f, a, b = ops[k]
+                # Eager-commit at open when read-only and already legal
+                # (the rest of `pending` was swept at this same state).
+                if f in readonly and step(state, f, a, b)[1]:
+                    done |= 1 << k
                 else:
-                    return False, None, flips  # undecided — kernels
-        commit = k if choice is None else choice
-        state = step(state, *ops[commit])[0]
-        done = sweep(state, done | (1 << commit), pending)
-        if choice is None:
-            pos += 1
-        # else: stay at pos — re-evaluate k's FORCE at the new state
-        pending = [o for o in pending if not (done >> o) & 1]
+                    pending.append(k)
+                pos += 1
+                continue
+            if et != EV_FORCE or (done >> k) & 1:
+                pos += 1
+                continue
+            s_k, legal_k = step(state, *ops[k])
+            choice = None
+            if legal_k:
+                # greedy direct commit; alternatives resolve lazily
+                if budget > 0 and any(not (done >> o) & 1
+                                      for o in pending):
+                    stack.append([pos, state, done, None, 1])
+            else:
+                cands = candidates(state, done, pending, k)
+                if cands:
+                    if len(cands) > 1 and budget > 0:
+                        stack.append([pos, state, done, cands, 1])
+                    choice = cands[0]
+                else:
+                    # dead end: restore the most recent choice point
+                    # with an untried option (one restore = one flip)
+                    while stack:
+                        cp = stack[-1]
+                        if cp[3] is None:  # lazy: enumerate at its state
+                            kc = ev_ops[cp[0]][1]
+                            pc = [o for o in range(opened_by[cp[0]])
+                                  if not (cp[2] >> o) & 1]
+                            cp[3] = candidates(cp[1], cp[2], pc, kc)
+                        if cp[4] < len(cp[3]):
+                            flips += 1
+                            if flips > budget:
+                                return False, None, flips
+                            pos, state, done = cp[0], cp[1], cp[2]
+                            choice = cp[3][cp[4]]
+                            cp[4] += 1
+                            k = ev_ops[pos][1]
+                            pending = [o for o in range(opened_by[pos])
+                                       if not (done >> o) & 1]
+                            break
+                        stack.pop()
+                    else:
+                        return False, None, flips  # undecided — kernels
+            commit = k if choice is None else choice
+            state = step(state, *ops[commit])[0]
+            done = sweep(state, done | (1 << commit), pending)
+            if choice is None:
+                pos += 1
+            # else: stay at pos — re-evaluate k's FORCE at the new state
+            pending = [o for o in pending if not (done >> o) & 1]
+    except _AbortBudget:
+        return False, None, flips  # abort budget spent — undecided
     return True, ("greedy" if flips == 0 else "backtrack"), flips
 
 
@@ -465,6 +496,280 @@ def greedy_certify(enc: EncodedHistory, model,
     """Boolean view of :func:`certify_encoded` (the historical PR-9
     entry; True = sound VALID witness built, False = undecided)."""
     return certify_encoded(enc, model, budget=budget)[0]
+
+
+# ------------------------------------------------- resumable certifier
+
+
+class StreamingCertifier:
+    """Incremental twin of :func:`certify_encoded` for streaming
+    sessions (ISSUE 14 tentpole (3)). `feed` consumes settled event
+    suffixes (the `IncrementalEncoder` output) and advances the same
+    witness construction, keeping the certifier's carry — (state,
+    done-set, pending, backtrack stack) — BETWEEN appends, so a
+    long-lived session's per-append cost is O(segment) instead of the
+    per-append full restart's O(history). The carry lives next to
+    `CarriedScan`'s ``{inner, left}`` kernel carry and, like it, is
+    never journaled: a crash resume replays the journaled segments
+    through the identical deterministic pipeline, so the rebuilt
+    certifier state is field-for-field identical to the uninterrupted
+    session's (pinned by tests/test_stream.py).
+
+    Differences from the one-shot scan, and why they are sound:
+
+      * ``op_forced`` is learned as FORCEs settle (the one-shot
+        pre-scans the whole stream). It only RANKS candidates
+        (will-be-forced before optional), so a late-learned force can
+        cost flips, never a wrong answer; the value-guide masks are
+        recomputed when an op's FORCE settles for the same reason.
+      * `certified` mid-stream means the settled PREFIX has a complete
+        witness — exactly what the per-append restart certified — and
+        the final `feed` (after the encoder's end-of-history settle)
+        certifies the whole history.
+      * Once undecided (flip budget spent, no restorable choice point)
+        the certifier is PERMANENTLY dead and the caller's kernel
+        carry takes over — it never un-decides, matching the one-shot
+        contract that undecided falls to the exact ladder.
+
+    The flip budget is length-scaled like the one-shot's
+    (`_effective_budget` over TOTAL settled events, re-resolved per
+    feed, so a growing session earns budget as it grows).
+
+    LOCK-STEP CONTRACT with :func:`certify_encoded`: `_sweep` /
+    `_candidates` / `_scan` mirror the one-shot's commit rules and
+    candidate ordering on purpose — the one-shot stays a hand-tuned
+    closure loop because it is the MEASURED hot path (the weak-rung
+    and lin-fastpath A/B numbers are pinned on it; the queue family
+    clears its acceptance bar by <1%, so method-dispatch overhead is
+    not free). A change to commit rules, ordering, or budgets in
+    either implementation must be mirrored in the other; the
+    cross-engine differential (tests/test_lin_fastpath.py
+    TestStreamingCertifier, random cuts vs the one-shot) is the
+    drift tripwire. The one-shot's `max_steps` abort budget is
+    deliberately absent here: a feed's work is already bounded by the
+    segment plus the length-scaled flip budget, and stream units have
+    their own size caps (JGRAFT_STREAM_GREEDY_MAX_EVENTS)."""
+
+    def __init__(self, model, budget: Optional[int] = None):
+        from ..models.base import EncodedOp
+
+        self._EncodedOp = EncodedOp
+        self._model = model
+        self._step = model.step
+        self._readonly = frozenset(
+            getattr(model, "readonly_fcodes", ()) or ())
+        self._base_budget = budget
+        # op table / event tape (append-only across feeds)
+        self._ops: List[tuple] = []
+        self._op_forced: List[bool] = []
+        self._ev_ops: List[tuple] = []
+        self._opened_by: List[int] = [0]
+        self._active: dict = {}      # slot -> op id (spans feeds)
+        # value-guide masks (grown per op; falls back to lookahead)
+        self._guide_ok = (hasattr(model, "enable_values")
+                          and hasattr(model, "observe_values"))
+        self._dom: dict = {}
+        self._em: List[int] = []
+        self._om: List[int] = []
+        # the carry proper
+        self._state = model.init_state()
+        self._done = 0
+        self._pending: List[int] = []
+        self._stack: deque = deque(maxlen=_BACKTRACK_STACK_CAP)
+        self._pos = 0
+        self._flips = 0
+        self._dead = False
+
+    # ------------------------------------------------------ accessors
+
+    @property
+    def certified(self) -> bool:
+        """True while every settled event so far is covered by a
+        complete legal witness (sound VALID for the settled prefix)."""
+        return not self._dead
+
+    @property
+    def tier(self) -> Optional[str]:
+        """Decided-tier attribution: "greedy" while the first-choice
+        path carried, "backtrack" once any flip was spent; None once
+        undecided."""
+        if self._dead:
+            return None
+        return "greedy" if self._flips == 0 else "backtrack"
+
+    def carry_state(self) -> dict:
+        """The certifier's carry, for the resume-identity tests (a
+        resumed session's replay must land field-for-field here)."""
+        return {
+            "pos": self._pos,
+            "ops": len(self._ops),
+            "done": self._done,
+            "state": self._state,
+            "pending": tuple(self._pending),
+            "flips": self._flips,
+            "stack_depth": len(self._stack),
+            "dead": self._dead,
+        }
+
+    # ---------------------------------------------------------- guide
+
+    def _guide_add(self, k: int) -> None:
+        """(Re)compute op k's enable/observe masks — on OPEN, and again
+        when its FORCE settles (the hooks may key on `forced`)."""
+        if not self._guide_ok:
+            return
+        f, a, b = self._ops[k]
+        eo = self._EncodedOp(f, a, b, self._op_forced[k])
+        evs = self._model.enable_values(eo)
+        ovs = self._model.observe_values(eo)
+        if evs is None or ovs is None:
+            self._guide_ok = False
+            return
+        masks = [0, 0]
+        for j, vals in enumerate((evs, ovs)):
+            for v in vals:
+                if v not in self._dom:
+                    if len(self._dom) >= 63:
+                        self._guide_ok = False
+                        return
+                    self._dom[v] = len(self._dom)
+                masks[j] |= 1 << self._dom[v]
+        self._em[k], self._om[k] = masks
+
+    # ----------------------------------------------------------- scan
+
+    def feed(self, events) -> bool:
+        """Consume one settled suffix ([n, 5] int32 rows) and advance
+        the witness; returns `certified`."""
+        rows = np.asarray(events).tolist() if len(events) else []
+        for row in rows:
+            et, slot = row[0], row[1]
+            if et == EV_OPEN:
+                k = len(self._ops)
+                self._ops.append((row[2], row[3], row[4]))
+                self._op_forced.append(False)
+                self._em.append(0)
+                self._om.append(0)
+                self._active[slot] = k
+                self._ev_ops.append((EV_OPEN, k))
+                self._guide_add(k)
+            elif et == EV_FORCE:
+                k = self._active.pop(slot)
+                self._op_forced[k] = True
+                self._ev_ops.append((EV_FORCE, k))
+                self._guide_add(k)
+            else:
+                self._ev_ops.append((0, -1))
+            self._opened_by.append(
+                self._opened_by[-1] + (1 if et == EV_OPEN else 0))
+        if self._dead:
+            return False
+        return self._scan()
+
+    def _sweep(self, state, done, pending) -> int:
+        step, readonly, ops = self._step, self._readonly, self._ops
+        for k in pending:
+            if not (done >> k) & 1 and ops[k][0] in readonly \
+                    and step(state, *ops[k])[1]:
+                done |= 1 << k
+        return done
+
+    def _candidates(self, state, done, pending, e) -> list:
+        step, ops = self._step, self._ops
+        te = ops[e]
+        legal_e = step(state, *te)[1]
+        out = []
+        if legal_e:
+            out.append((-1, 0, 0, -1, None))
+        for k in pending:
+            if (done >> k) & 1 or k == e:
+                continue
+            s2, legal = step(state, *ops[k])
+            if not legal:
+                continue
+            if self._guide_ok and not (self._em[k] & self._om[e]):
+                enables = 1  # mask proves k exposes nothing e observes
+            else:
+                enables = 0 if step(s2, *te)[1] else 1
+            out.append((0, enables,
+                        0 if self._op_forced[k] else 1, k, k))
+        out.sort(key=lambda t: t[:4])
+        return [t[4] for t in out]
+
+    def _scan(self) -> bool:
+        """The certify_encoded main loop over the not-yet-consumed
+        tape suffix, reading/writing the instance carry."""
+        step, ops, ev_ops = self._step, self._ops, self._ev_ops
+        readonly, opened_by = self._readonly, self._opened_by
+        base = (self._base_budget if self._base_budget is not None
+                else greedy_backtrack_budget())
+        budget = _effective_budget(base, len(ev_ops))
+        state, done, pending = self._state, self._done, self._pending
+        stack, pos, flips = self._stack, self._pos, self._flips
+        n_ev = len(ev_ops)
+        ok = True
+        while pos < n_ev:
+            et, k = ev_ops[pos]
+            if et == EV_OPEN:
+                f, a, b = ops[k]
+                if f in readonly and step(state, f, a, b)[1]:
+                    done |= 1 << k
+                else:
+                    pending.append(k)
+                pos += 1
+                continue
+            if et != EV_FORCE or (done >> k) & 1:
+                pos += 1
+                continue
+            legal_k = step(state, *ops[k])[1]
+            choice = None
+            if legal_k:
+                if budget > 0 and any(not (done >> o) & 1
+                                      for o in pending):
+                    stack.append([pos, state, done, None, 1])
+            else:
+                cands = self._candidates(state, done, pending, k)
+                if cands:
+                    if len(cands) > 1 and budget > 0:
+                        stack.append([pos, state, done, cands, 1])
+                    choice = cands[0]
+                else:
+                    while stack:
+                        cp = stack[-1]
+                        if cp[3] is None:
+                            kc = ev_ops[cp[0]][1]
+                            pc = [o for o in range(opened_by[cp[0]])
+                                  if not (cp[2] >> o) & 1]
+                            cp[3] = self._candidates(cp[1], cp[2], pc,
+                                                     kc)
+                        if cp[4] < len(cp[3]):
+                            flips += 1
+                            if flips > budget:
+                                ok = False
+                                break
+                            pos, state, done = cp[0], cp[1], cp[2]
+                            choice = cp[3][cp[4]]
+                            cp[4] += 1
+                            k = ev_ops[pos][1]
+                            pending = [o for o in range(opened_by[pos])
+                                       if not (done >> o) & 1]
+                            break
+                        stack.pop()
+                    else:
+                        ok = False  # no restorable choice — undecided
+                    if not ok:
+                        break
+            commit = k if choice is None else choice
+            state = step(state, *ops[commit])[0]
+            done = self._sweep(state, done | (1 << commit), pending)
+            if choice is None:
+                pos += 1
+            pending = [o for o in pending if not (done >> o) & 1]
+        self._state, self._done, self._pending = state, done, pending
+        self._pos, self._flips = pos, flips
+        if not ok:
+            self._dead = True
+        return not self._dead
 
 
 # ------------------------------------------------------------ batch entry

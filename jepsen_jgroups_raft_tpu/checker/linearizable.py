@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -86,6 +87,147 @@ DEFAULT_MAX_CPU_CONFIGS = 1 << 18
 #: import time; now it warns and falls back to the measured default.
 PLATFORM_ROUTE_MIN_CELLS = env_int("JGRAFT_ROUTE_MIN_CELLS", 64_000,
                                    minimum=0)
+
+
+# --------------------------------------- lin-rung fast path (ISSUE 14)
+# The cheap-decision tier (checker/consistency.certify_encoded) runs as
+# a PRE-KERNEL pass on the un-relaxed stream at the linearizable rung:
+# a witness respecting every [OPEN, FORCE] interval of the original
+# encoding IS a linearization, so a certified row is a sound VALID
+# decided on the host in O(E·W) — no kernel launch, no batch slot.
+# Undecided rows fall through to the ordinary ladder unchanged, so
+# verdicts are bitwise-identical with the path force-disabled
+# (JGRAFT_LIN_FASTPATH=0, the ablation/A-B arm). The worst case (host
+# scan AND kernel) is bounded two ways: a length-scaled abort budget
+# per row, and measured per-bucket gating (checker/autotune.py
+# lin_fastpath_route) that routes low-hit buckets kernel-first.
+
+#: Algorithms the fast path fronts: the kernel-launching selectors. An
+#: explicit "cpu"/"dfs" keeps its host engine (tests use them as
+#: oracles), and "race" already runs its own host engine concurrently.
+#: Shared with graftd's dispatch fast lane (service/scheduler.py) so
+#: the two surfaces can never drift.
+LIN_FASTPATH_ALGOS = ("auto", "jax", "pallas")
+
+
+def lin_fastpath_on() -> bool:
+    """Whether the linearizable-rung pre-kernel certify pass runs.
+    Default ON; ``JGRAFT_LIN_FASTPATH=0`` force-disables (defensive
+    parse — garbage keeps the default)."""
+    return env_int("JGRAFT_LIN_FASTPATH", 1, minimum=0) != 0
+
+
+def lin_abort_steps() -> int:
+    """Per-event abort budget for the fast path's host scan
+    (``JGRAFT_LIN_FASTPATH_ABORT``, default 32 `model.step` calls per
+    stream event; 0 = unbounded). A hopeless row aborts after
+    budget·E steps, bounding its cost to a fraction of its kernel
+    wall. Calibrated on the 200×1k host-CPU A/B (2026-08-04): valid
+    rows certify in ~2–8 step calls per event (register 8 ms/row, the
+    heaviest backtracking family queue ~15 ms/row, both far under the
+    budget), while an uncertifiable row at 128/event burned ~2.5× its
+    per-row kernel wall — 32/event keeps the worst case under it."""
+    return env_int("JGRAFT_LIN_FASTPATH_ABORT", 32, minimum=0)
+
+
+_FP_LOCK = threading.Lock()
+_FP_ZERO = {"rows_scanned": 0, "rows_certified": 0, "rows_gated": 0,
+            "rows_rung_skipped": 0, "certify_wall_s": 0.0}
+_FP_COUNTERS = dict(_FP_ZERO)
+
+
+def _fp_bump(**kw) -> None:
+    with _FP_LOCK:
+        for k, v in kw.items():
+            _FP_COUNTERS[k] += v
+
+
+def fastpath_counters() -> dict:
+    """Process-wide lin-fastpath counters (non-destructive):
+    rows_scanned/rows_certified (hit-rate numerator/denominator),
+    rows_gated (routed kernel-first by the measured gate),
+    rows_rung_skipped (weak-rung re-entries that skipped the redundant
+    second scan — the ISSUE-14 double-scan satellite's evidence), and
+    the summed certify wall."""
+    with _FP_LOCK:
+        return dict(_FP_COUNTERS)
+
+
+def consume_fastpath_counters() -> dict:
+    """Return and reset the counters (bench.py reads one window's
+    worth)."""
+    global _FP_COUNTERS
+    with _FP_LOCK:
+        out = dict(_FP_COUNTERS)
+        _FP_COUNTERS = dict(_FP_ZERO)
+        return out
+
+
+def lin_fastpath_pass(encs: Sequence[EncodedHistory], model,
+                      note: bool = True) -> list:
+    """Run the certifier over a linearizable-rung batch; returns one
+    result dict per row, None where undecided (the caller sends those
+    through the kernel ladder). Rows are grouped into the autotuner's
+    gating buckets; gated buckets are skipped wholesale (counted), and
+    every scanned bucket's (rows, hits, wall) feeds the gate's record.
+    Also the graftd fast lane's engine (service/scheduler.py), which
+    passes ``note=False``: its all-or-nothing rule may DISCARD a
+    partially-certified request's results, and a discarded row must
+    not be tier-attributed here only to be attributed again by the
+    kernel that actually decides it — the lane notes tiers itself for
+    the requests it delivers. The `fastpath_counters` bumps stay
+    unconditional: rows_scanned/rows_certified count SCAN outcomes
+    (the gate's hit-rate evidence), not delivered verdicts."""
+    from .consistency import certify_encoded
+
+    results: list = [None] * len(encs)
+    fam = type(model).__name__
+    buckets: dict = {}
+    for i, e in enumerate(encs):
+        if e.n_events <= 0:
+            continue  # trivial rows keep their "trivial" tier
+        buckets.setdefault(
+            autotune.lin_fastpath_sig(fam, e.n_events), []).append(i)
+    abort = lin_abort_steps()
+    for sig, idxs in buckets.items():
+        if not autotune.lin_fastpath_route(sig):
+            _fp_bump(rows_gated=len(idxs))
+            continue
+        t0 = time.perf_counter()
+        hits = 0
+        for i in idxs:
+            e = encs[i]
+            ok, tier, _ = certify_encoded(
+                e, model,
+                max_steps=abort * max(e.n_events, 1) if abort else None)
+            if ok:
+                hits += 1
+                results[i] = {
+                    "valid?": VALID,
+                    "algorithm": "greedy-witness",
+                    "op-count": e.n_ops,
+                    "concurrency-window": e.n_slots,
+                    # namespaced distinctly from the weak-rung
+                    # certifier's greedy/backtrack so fleet tier
+                    # attribution never conflates the two hit-rates
+                    "decided-tier": tier + "@lin",
+                }
+        dt = time.perf_counter() - t0
+        # Fair-share wall attribution, same stance as the weak rung:
+        # every scanned row (certified or not) cost ~dt/len(idxs); the
+        # certified rows book that share, the undecided rows' verdict
+        # cost is the kernel tier's wall.
+        per_row = dt / max(len(idxs), 1)
+        if note:
+            for i in idxs:
+                if results[i] is not None:
+                    note_tier(results[i]["decided-tier"],
+                              wall_s=per_row)
+        autotune.lin_fastpath_observe(sig, rows=len(idxs), hits=hits,
+                                      wall_s=dt)
+        _fp_bump(rows_scanned=len(idxs), rows_certified=hits,
+                 certify_wall_s=dt)
+    return results
 
 
 def _route_group_to_host(n_rows: int, n_events: int) -> bool:
@@ -156,6 +298,7 @@ def check_encoded(
     max_cpu_configs: Optional[int] = DEFAULT_MAX_CPU_CONFIGS,
     distribute: bool = True,
     consistency: str = "linearizable",
+    lin_fastpath: Optional[bool] = None,
 ) -> list[dict]:
     """Pack-once/check-many entry: verify histories that are ALREADY
     encoded (`history.packing.encode_history`), one result dict each.
@@ -188,6 +331,15 @@ def check_encoded(
     grouping, bucketing, chunked wavefront, distribution, graftd
     coalescing) serves the rungs unchanged. Results carry a
     ``consistency`` key whenever a non-default rung decided them.
+
+    ``lin_fastpath`` (ISSUE 14): None = the default — at the
+    linearizable rung with a kernel algorithm, run the pre-kernel
+    certify pass (`lin_fastpath_pass`) and send only undecided rows to
+    the kernels. False = skip it; the weak-rung recursion below passes
+    False because its rows were ALREADY scanned by the rung certifier
+    on a superset-legality stream (a second scan of the relaxed bytes
+    is pure waste — rows_rung_skipped counts the saved work), and
+    graftd's fast lane passes False after certifying at dispatch.
     """
     from ..parallel import distributed
 
@@ -246,10 +398,22 @@ def check_encoded(
                 if hits:
                     todo = [i for i in todo if results[i] is None]
         if todo:
+            # lin_fastpath=False (ISSUE-14 satellite): these rows were
+            # already scanned by the rung certifier above — on the
+            # ORIGINAL stream and again on the relaxed one, whose
+            # legality is a superset — so the lin fast path re-scanning
+            # the relaxed bytes could never certify what apply_rung
+            # just failed to. The counter proves the skip fires — and
+            # only counts when the fast path would otherwise have run
+            # (a JGRAFT_LIN_FASTPATH=0 ablation run must keep its
+            # lin-fastpath counters absent, not claim saved scans).
+            if algorithm in LIN_FASTPATH_ALGOS and lin_fastpath_on():
+                _fp_bump(rows_rung_skipped=len(todo))
             sub = check_encoded([relaxed[i] for i in todo], model,
                                 algorithm, n_configs, n_slots, witness,
                                 max_cpu_configs, distribute,
-                                consistency="linearizable")
+                                consistency="linearizable",
+                                lin_fastpath=False)
             for i, r in zip(todo, sub):
                 results[i] = r
         if consistency == "session":
@@ -258,18 +422,47 @@ def check_encoded(
             r["consistency"] = consistency
         return results  # type: ignore[return-value]
 
-    if distribute and distributed.wavefront_active() and len(encs) > 1:
-        results = distributed.run_sharded(
-            encs,
-            lambda sub: _check_encoded(sub, model, algorithm, n_configs,
-                                       n_slots, witness, max_cpu_configs),
-            # the result-detail exchange (ISSUE 11 tentpole (d)) keys
-            # its store records over (model, algorithm, row encoding);
-            # inert unless a shared store dir is configured
-            model=model, algorithm=algorithm)
+    def _kernel_path(rest):
+        if distribute and distributed.wavefront_active() and len(rest) > 1:
+            return distributed.run_sharded(
+                rest,
+                lambda sub: _check_encoded(sub, model, algorithm,
+                                           n_configs, n_slots, witness,
+                                           max_cpu_configs),
+                # the result-detail exchange (ISSUE 11 tentpole (d))
+                # keys its store records over (model, algorithm, row
+                # encoding); inert unless a shared store dir is
+                # configured
+                model=model, algorithm=algorithm)
+        return _check_encoded(rest, model, algorithm, n_configs,
+                              n_slots, witness, max_cpu_configs)
+
+    # Lin-rung pre-kernel fast path (ISSUE 14): certify on the host,
+    # evict VALID rows from the batch BEFORE grouping/bucketing/
+    # chunked-wavefront. NOT inside an active distributed wavefront:
+    # the certify pass itself is deterministic, but the measured gate
+    # (autotune linfp records) is HOST-LOCAL state — two cluster
+    # processes with different gate histories would evict different
+    # rows and the SPMD collectives would mismatch. Sharded batches
+    # stay kernel-first until the gate store is shared (ROADMAP
+    # item 3's on-chip round); graftd's per-host lane is unaffected
+    # (its scheduler pins distribute=False).
+    distributing = (distribute and distributed.wavefront_active()
+                    and len(encs) > 1)
+    fp = None
+    if (lin_fastpath is not False and encs and not distributing
+            and algorithm in LIN_FASTPATH_ALGOS and lin_fastpath_on()):
+        fp = lin_fastpath_pass(encs, model)
+        if not any(r is not None for r in fp):
+            fp = None
+    if fp is not None:
+        todo = [i for i, r in enumerate(fp) if r is None]
+        results = fp
+        if todo:
+            for i, r in zip(todo, _kernel_path([encs[i] for i in todo])):
+                results[i] = r
     else:
-        results = _check_encoded(encs, model, algorithm, n_configs,
-                                 n_slots, witness, max_cpu_configs)
+        results = _kernel_path(encs)
     note = degraded_note()
     if note:
         # The platform silently degraded (TPU probe failed / tunnel
@@ -876,14 +1069,18 @@ def _jx(valid, enc: EncodedHistory, secs: float,
 def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
                        max_cpu_configs: Optional[int]
                        = DEFAULT_MAX_CPU_CONFIGS,
-                       consistency: str = "linearizable") -> dict:
+                       consistency: str = "linearizable",
+                       lin_fastpath: Optional[bool] = None) -> dict:
     """Host-only verdict ladder for one encoded history: the capped CPU
     frontier first, the budgeted DFS when the frontier reports UNKNOWN —
     never a device launch. This is graftd's degrade path (the service
     re-checks a batch through it when the device pass raises mid-check),
     mirroring `auto` mode's escalation order without re-entering jax.
     A weaker ``consistency`` rung relaxes/greedy-certifies exactly like
-    `check_encoded`, so degraded rung verdicts match the device path."""
+    `check_encoded`, so degraded rung verdicts match the device path —
+    and at the linearizable rung the same pre-frontier certify fast
+    path runs (ISSUE 14; `lin_fastpath=False` skips it, e.g. graftd's
+    fast lane having already certified at dispatch)."""
     if enc.n_events == 0:
         note_tier("trivial")
         return {"valid?": VALID, "algorithm": "trivial", "op-count": 0,
@@ -937,6 +1134,36 @@ def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
                             "cycle": c["cycle"],
                             "exact-sc-refutation": True,
                             "consistency": consistency}
+    if consistency == "linearizable" and lin_fastpath is not False \
+            and lin_fastpath_on():
+        # Lin-rung fast path, host flavor (ISSUE 14): a witness on the
+        # un-relaxed stream is a lin witness, so a certified row skips
+        # the (worst-case exponential) frontier search entirely. Same
+        # gating bucket + abort budget as the device path's pass.
+        from .consistency import certify_encoded
+
+        sig = autotune.lin_fastpath_sig(type(model).__name__,
+                                        enc.n_events)
+        if autotune.lin_fastpath_route(sig):
+            abort = lin_abort_steps()
+            t0 = time.perf_counter()
+            ok, tier, _ = certify_encoded(
+                enc, model,
+                max_steps=abort * max(enc.n_events, 1) if abort
+                else None)
+            dt = time.perf_counter() - t0
+            autotune.lin_fastpath_observe(sig, rows=1, hits=int(ok),
+                                          wall_s=dt)
+            _fp_bump(rows_scanned=1, rows_certified=int(ok),
+                     certify_wall_s=dt)
+            if ok:
+                note_tier(tier + "@lin", wall_s=dt)
+                return {"valid?": VALID, "algorithm": "greedy-witness",
+                        "op-count": enc.n_ops,
+                        "concurrency-window": enc.n_slots,
+                        "decided-tier": tier + "@lin"}
+        else:
+            _fp_bump(rows_gated=1)
     r = _check_cpu(enc, model, witness, max_cpu_configs)
     if r.get("valid?") is UNKNOWN:
         r2 = _check_dfs(enc, model, witness, max_steps=DEFAULT_DFS_BUDGET)

@@ -97,10 +97,19 @@ class PerfChecker(Checker):
         # decided this run's verdicts, with per-tier wall time — at
         # fleet scale the cheap-tier decided fraction IS the capacity
         # model, so the per-run store carries it next to the scan
-        # counters.
+        # counters. Lin-rung fast-path hits are namespaced
+        # ``greedy@lin``/``backtrack@lin`` (ISSUE 14) so the fleet view
+        # never conflates the weak-rung certifier's hit-rate with the
+        # linearizable fast path's.
         tiers = tier_summary()
         if tiers is not None:
             out["decided-tiers"] = tiers
+        # Lin fast-path engagement (ISSUE 14): scanned/certified/gated
+        # row counts + certify wall — the hit-rate evidence beside the
+        # per-bucket gating store's persisted records.
+        fp = lin_fastpath_summary()
+        if fp is not None:
+            out["lin-fastpath"] = fp
         store_dir = (test or {}).get("store_dir")
         if self.render and store_dir:
             try:
@@ -155,6 +164,23 @@ def autotune_summary():
     return {"plans-loaded": c["plans_loaded"],
             "plans-measured": c["plans_measured"],
             "plan-misses": c["plan_misses"]}
+
+
+def lin_fastpath_summary():
+    """Process-level lin-fastpath counters
+    (checker/linearizable.fastpath_counters), or None when the fast
+    path never engaged — absent beats all-zero in stored results, same
+    stance as the autotune block."""
+    from .linearizable import fastpath_counters
+
+    c = fastpath_counters()
+    if not any(c.values()):
+        return None
+    return {"rows-scanned": c["rows_scanned"],
+            "rows-certified": c["rows_certified"],
+            "rows-gated": c["rows_gated"],
+            "rows-rung-skipped": c["rows_rung_skipped"],
+            "certify-wall-s": round(c["certify_wall_s"], 4)}
 
 
 def format_tier_stats(tiers: dict):

@@ -217,6 +217,11 @@ class CheckingService:
             "max_queue_depth": 0, "worker_restarts": 0, "trace_errors": 0,
             "recovered_requests": 0, "attached_requests": 0,
             "quarantined": 0, "watchdog_requeues": 0,
+            # lin-rung fast lane (ISSUE 14): requests fully decided by
+            # the host certifier at dispatch — never a batch slot, a
+            # shard queue, or a kernel launch. Always in the schema,
+            # zero when the lane is off.
+            "fastpath_requests": 0,
             # cluster tier (ISSUE 11) — always in the schema, zero when
             # clustering is not configured (the seam stays inert)
             "store_hits": 0, "store_puts": 0,
@@ -604,7 +609,8 @@ class CheckingService:
         executor, so independent shape buckets run concurrently."""
         tid = threading.get_ident()
         while not self._stop.is_set() and not self._abandoned():
-            batch = self.scheduler.next_batch(timeout=IDLE_POLL_S)
+            batch = self.scheduler.next_batch(
+                timeout=IDLE_POLL_S, on_decided=self._fastlane_done)
             if not batch:
                 continue
             rows = sum(r.n_rows for r in batch)
@@ -633,6 +639,23 @@ class CheckingService:
                 # and routing: fail the batch loudly, like the drains.
                 self.shards.done(k, rows)
                 self._fail_unexecuted(batch)
+
+    def _fastlane_done(self, done) -> None:
+        """Account requests the dispatch fast lane decided (ISSUE 14):
+        they never reach a shard queue or `scheduler.execute`, so the
+        completed/latency/cache/tier accounting and trace writes that
+        normally ride the batch path run here. The results are clean
+        host verdicts (never degraded), so the fingerprint cache and
+        cluster store serve resubmissions exactly like batch verdicts."""
+        with self._lock:
+            self._stats["fastpath_requests"] += len(done)
+            for r in done:
+                for tier, n in r.stats.get("decided_tier", {}).items():
+                    self._tier_counts[tier] = \
+                        self._tier_counts.get(tier, 0) + n
+        self._account_requests(done)
+        for r in done:
+            self._write_trace(r)
 
     def _fail_unexecuted(self, batch) -> None:
         """A shutdown is not a verdict: requests popped from admission

@@ -159,7 +159,7 @@ class BatchScheduler:
         from ..checker.linearizable import check_encoded, check_encoded_host
 
         def _check_local(encs, model, algorithm="auto",
-                         consistency="linearizable"):
+                         consistency="linearizable", lin_fastpath=None):
             # distribute=False: graftd's admission queue is HOST-local
             # — different daemon processes hold different batches, so
             # the cross-host SPMD seam (which barriers on every process
@@ -169,11 +169,20 @@ class BatchScheduler:
             # (doc/checker-design.md §10).
             return check_encoded(encs, model, algorithm=algorithm,
                                  distribute=False,
-                                 consistency=consistency)
+                                 consistency=consistency,
+                                 lin_fastpath=lin_fastpath)
 
         #: device-path seam (tests inject failures / gates here).
         self.check_fn = check_fn or _check_local
         self.host_fallback = host_fallback or check_encoded_host
+        #: graftd fast lane (ISSUE 14): enabled only on the DEFAULT
+        #: check path — an injected check_fn is a test/ops seam that
+        #: must observe every batch, so the lane never short-circuits
+        #: it. `_default_host_fallback` gates whether the degrade arm
+        #: may receive the lin_fastpath kwarg (an injected fallback
+        #: predates it).
+        self.fastlane_enabled = check_fn is None
+        self._default_host_fallback = host_fallback is None
         self.max_batch_rows = (max_batch_rows if max_batch_rows is not None
                                else env_int("JGRAFT_SERVICE_MAX_BATCH_ROWS",
                                             DEFAULT_MAX_BATCH_ROWS,
@@ -210,15 +219,107 @@ class BatchScheduler:
             rows += r.n_rows
         return batch
 
-    def next_batch(self, timeout: float) -> List[CheckRequest]:
+    def fastlane(self, batch: List[CheckRequest]
+                 ) -> tuple[List[CheckRequest], List[CheckRequest]]:
+        """graftd fast lane (ISSUE 14): certify each popped request's
+        rows on the host BEFORE the batch lingers, occupies a shard
+        queue, or launches a kernel. Returns ``(decided, live)`` —
+        decided requests are already finished DONE (sub-batch-latency
+        verdicts; the daemon accounts/traces them), live ones proceed
+        to the ordinary coalesced launch with the redundant in-checker
+        fast path suppressed (execute passes ``lin_fastpath=False``).
+
+        All-or-nothing per request: a partially-certifiable request
+        stays live whole — its rows ride one launch and demux by row
+        count, so evicting a subset would tear the fingerprint/trace
+        contract. The abort budget + per-bucket gating inside
+        `lin_fastpath_pass` bound what a hopeless request costs here.
+        Tier attribution is noted HERE, only for delivered requests
+        (``note=False`` in the pass): a discarded partial result's
+        rows are decided — and attributed — by the kernel launch they
+        proceed to, never double-counted."""
+        from ..checker.linearizable import (LIN_FASTPATH_ALGOS,
+                                            lin_fastpath_on,
+                                            lin_fastpath_pass)
+        from ..checker.schedule import note_tier
+
+        if not batch or not self.fastlane_enabled \
+                or not lin_fastpath_on():
+            return [], batch
+        from ..checker.base import VALID
+
+        decided, live = [], []
+        for r in batch:
+            if (r.terminal or r.cancelled.is_set()
+                    or r.consistency != "linearizable"
+                    or r.algorithm not in LIN_FASTPATH_ALGOS
+                    or r.force_host or not r.encs):
+                live.append(r)
+                continue
+            t0 = time.monotonic()
+            rs = lin_fastpath_pass(r.encs, r.model, note=False)
+            # the pass deliberately leaves 0-event rows undecided (the
+            # kernel path stamps them "trivial"); here they are
+            # host-decidable for free and must not force an otherwise
+            # fully-certified request onto the batch path
+            for j, enc in enumerate(r.encs):
+                if rs[j] is None and enc.n_events <= 0:
+                    rs[j] = {"valid?": VALID, "algorithm": "trivial",
+                             "op-count": 0, "decided-tier": "trivial"}
+            # the lane SCANNED this request: execute() may suppress the
+            # redundant in-checker re-scan for it (and only for it)
+            r._fp_tried = True
+            if not all(res is not None for res in rs):
+                live.append(r)
+                continue
+            wall = time.monotonic() - t0
+            # honor a cancel that landed DURING the scan — the batch
+            # path's demux re-checks at the same point (first-wins
+            # finish keeps the race harmless either way)
+            if r.cancelled.is_set():
+                r.finish(CANCELLED)
+                decided.append(r)
+                continue
+            tiers: dict = {}
+            for res in rs:
+                t = res["decided-tier"]
+                tiers[t] = tiers.get(t, 0) + 1
+                note_tier(t, wall_s=wall / max(len(rs), 1))
+            r.stats = {
+                "fastlane": True,
+                "batched_requests": 0,
+                "batch_rows": r.n_rows,
+                "batch_wall_s": round(wall, 4),
+                "decided_tier": tiers,
+                "placement": {"shard": None, "n_shards": 0},
+                "degraded": False,
+            }
+            r.finish(DONE, results=rs)
+            decided.append(r)
+        return decided, live
+
+    def next_batch(self, timeout: float,
+                   on_decided=None) -> List[CheckRequest]:
         """Block up to `timeout` for a batch. After the first pick, if
         the launch is far from full and the head's deadline allows,
         linger one batch-wait window and sweep in same-bucket arrivals
         (deadline order is preserved: the linger only ever ADDS rows to
-        the head's launch, it never reorders across buckets)."""
+        the head's launch, it never reorders across buckets).
+
+        ``on_decided`` (ISSUE 14): when given, the fast lane certifies
+        the popped requests FIRST — before the linger, so a decided
+        request's latency is the host scan, not the batching window —
+        and decided requests are delivered to the callback instead of
+        the returned batch (linger top-ups ride the lane too)."""
         batch = self.queue.take(self._choose, timeout)
         if not batch:
             return batch
+        if on_decided is not None:
+            done, batch = self.fastlane(batch)
+            if done:
+                on_decided(done)
+            if not batch:
+                return []
         head = batch[0]
         rows = sum(r.n_rows for r in batch)
         slack = head.deadline - time.monotonic()
@@ -240,7 +341,12 @@ class BatchScheduler:
                     extra_rows += r.n_rows
                 return extra
 
-            batch.extend(self.queue.take(topup, timeout=0.0))
+            extra = self.queue.take(topup, timeout=0.0)
+            if on_decided is not None and extra:
+                done, extra = self.fastlane(extra)
+                if done:
+                    on_decided(done)
+            batch.extend(extra)
         # Requests cancelled between pop and here stay in the batch:
         # execute() finalizes them as CANCELLED (dropping them silently
         # would leave their waiters blocked forever).
@@ -285,6 +391,22 @@ class BatchScheduler:
         # the consistency parameter).
         check_kw = ({"consistency": consistency}
                     if consistency != "linearizable" else {})
+        if consistency == "linearizable" and self.fastlane_enabled \
+                and live and all(getattr(r, "_fp_tried", False)
+                                 for r in live):
+            # ISSUE 14: the dispatch fast lane actually SCANNED every
+            # request in this batch — the in-checker fast path
+            # re-scanning them inside check_encoded would be the
+            # double-scan the rung-skip satellite closes. Requests the
+            # lane skipped WITHOUT scanning (force_host retries,
+            # cancelled-at-pop, non-kernel algorithms) keep the
+            # checker/host-ladder fast path: for them nothing was
+            # tried yet. Only on the default check path (injected
+            # seams keep their arity).
+            check_kw["lin_fastpath"] = False
+        host_kw = dict(check_kw)
+        if not self._default_host_fallback:
+            host_kw.pop("lin_fastpath", None)
         label = "graftd:" + ",".join(r.id for r in live)
         degraded_note_local = None
         # Autotune consult marker (PR 6): the checker applies per-bucket
@@ -334,7 +456,7 @@ class BatchScheduler:
                     f"{type(e).__name__}: {e}"[:300])
                 if is_backend_init_failure(e):
                     note_degraded(degraded_note_local)
-                results = [self.host_fallback(enc, model, **check_kw)
+                results = [self.host_fallback(enc, model, **host_kw)
                            for enc in encs]
                 for res in results:
                     res["platform-degraded"] = degraded_note_local

@@ -16,9 +16,15 @@ segment boundaries:
   the SETTLED suffix of the one-shot event stream per append (an op's
   event content is final once its completion is recorded; settled
   events are prefix-stable under appends).
-* **Greedy fast path** — the PR 9 greedy certifier
-  (`checker.consistency.greedy_certify`) runs per segment on the
-  settled stream: most valid sessions never launch a kernel at all.
+* **Resumable certifier fast path** — the value-guided
+  bounded-backtrack certifier runs per segment as a RESUMABLE carry
+  (`checker.consistency.StreamingCertifier`, ISSUE 14): its (state,
+  done-set, pending, backtrack frame) persists between appends next to
+  the kernel carry below, so an append costs O(segment) instead of the
+  per-append restart's O(history). Most valid sessions never launch a
+  kernel at all; certifications are tier-stamped ``greedy@lin`` /
+  ``backtrack@lin`` (a stream session certifies the linearizable
+  rung).
 * **Carried chunk scan** — once greedy declines (or the stream outgrows
   its cap), `checker.schedule.CarriedScan` owns the chunked wavefront's
   ``{inner, left}`` carry BETWEEN appends: each segment's new events
@@ -198,7 +204,17 @@ class StreamUnit:
         self.ops_total = 0
         self.greedy = True            # greedy fast path still carries
         self.certified = False        # greedy proved the settled prefix
-        self.certify_tier = None      # "greedy"/"backtrack" (ISSUE 13)
+        self.certify_tier = None      # "greedy@lin"/"backtrack@lin"
+        #: resumable certifier carry (ISSUE 14): the witness scan's
+        #: (state, done-set, pending, backtrack stack) owned BETWEEN
+        #: appends next to the kernel carry below — per-append certify
+        #: cost is O(segment), not the PR-13 restart's O(history).
+        #: Rebuilt deterministically on replay like the kernel carry.
+        self.certifier = None         # consistency.StreamingCertifier
+        #: settled suffixes not yet fed to the certifier (the carry's
+        #: feed-queue twin; cleared when the unit leaves the greedy
+        #: path).
+        self.cert_queue: List[np.ndarray] = []
         self.scan: Optional[CarriedScan] = None
         self.spilled = False
         self.escalated = False        # needs the full ladder at finish
@@ -238,6 +254,8 @@ class StreamUnit:
         self.pending = []
         self.ops = []
         self.scan = None
+        self.certifier = None
+        self.cert_queue = []
         self.enc = None
 
     def drain_pending(self) -> None:
@@ -263,6 +281,8 @@ class StreamUnit:
             self.ops.extend(ops)
         if ev.shape[0]:
             self.pending.append(ev)
+            if self.greedy:
+                self.cert_queue.append(ev)
             if not self.spilled:
                 self._events.append(ev)
                 self._op_index.append(oi)
@@ -405,22 +425,21 @@ class StreamSession:
         self._maybe_spill(unit)
         if unit.greedy:
             if unit.spilled or unit.enc.n_events > greedy_max_events():
-                unit.greedy = False
+                self._drop_certifier(unit)
             else:
-                # ISSUE 13: the value-guided bounded-backtrack
-                # certifier — mutator-ambiguous register segments that
-                # PR-9 greedy handed to the carried kernel now certify
-                # per segment (tier recorded for the final verdict).
-                from ..checker.consistency import certify_encoded
-
-                ok, tier, _ = certify_encoded(
-                    unit.settled_encoding(), self.model)
-                if ok:
+                # ISSUE 13/14: the value-guided bounded-backtrack
+                # certifier, RESUMABLE — the carry (state, done-set,
+                # pending, backtrack frame) persists between appends,
+                # so this feed costs O(segment) where the PR-13
+                # per-append restart re-scanned from op 0. Tier
+                # namespaced @lin: a stream session certifies the
+                # linearizable rung (fleet attribution must not
+                # conflate it with the weak-rung certifier).
+                if self._feed_certifier(unit):
                     unit.certified = True
-                    unit.certify_tier = tier
+                    unit.certify_tier = unit.certifier.tier + "@lin"
                     return
-                unit.greedy = False
-                unit.certified = False
+                self._drop_certifier(unit)
         # Kernel path: build/rebuild the carry, then drain the feed
         # queue. A window that outgrew the carry's slot bucket rebuilds
         # a wider carry and re-feeds the whole settled stream — the
@@ -436,6 +455,32 @@ class StreamSession:
                 unit.escalated = True
                 return
             self._decide_invalid(unit, seq)
+
+    def _feed_certifier(self, unit: StreamUnit) -> bool:
+        """Drain the unit's settled-suffix queue into its resumable
+        certifier (lazily built); True while the settled prefix stays
+        certified."""
+        from ..checker.consistency import StreamingCertifier
+
+        if unit.certifier is None:
+            unit.certifier = StreamingCertifier(self.model)
+        ok = unit.certifier.certified
+        for ev in unit.cert_queue:
+            ok = unit.certifier.feed(ev)
+            if not ok:
+                break
+        unit.cert_queue = []
+        return ok
+
+    def _drop_certifier(self, unit: StreamUnit) -> None:
+        """The unit leaves the greedy path (spill, size cap, or an
+        undecided certifier): free the certifier carry — the kernel
+        carry takes over, and a dead certifier never un-decides."""
+        unit.greedy = False
+        unit.certified = False
+        unit.certify_tier = None
+        unit.certifier = None
+        unit.cert_queue = []
 
     def _ensure_scan(self, unit: StreamUnit, final: bool) -> bool:
         """Build (or rebuild, when the window outgrew the slot bucket)
@@ -482,7 +527,7 @@ class StreamSession:
         # buffers it would otherwise re-feed from
         if self._ensure_scan(unit, final=False):
             unit.drain_pending()
-        unit.greedy = False
+        self._drop_certifier(unit)
         unit.spilled = True
         unit._events = []
         unit._op_index = []
@@ -567,18 +612,21 @@ class StreamSession:
             unit.ingest([], final=True)
         if unit.greedy and not unit.spilled \
                 and unit.enc.n_events <= greedy_max_events():
-            from ..checker.consistency import certify_encoded
             from ..checker.schedule import note_tier
 
-            ok, tier, _ = certify_encoded(unit.settled_encoding(),
-                                          self.model)
-            if ok:
+            # The resumable certifier consumes only the final settle
+            # suffix here (ISSUE 14) — the earlier segments' witness
+            # is already in its carry.
+            if self._feed_certifier(unit):
+                tier = unit.certifier.tier + "@lin"
+                unit.certified = True
+                unit.certify_tier = tier
                 note_tier(tier)
                 return {"valid?": VALID, "algorithm": "greedy-witness",
                         "op-count": unit.enc.n_ops,
                         "concurrency-window": unit.enc.n_slots,
                         "decided-tier": tier}
-        unit.greedy = False
+        self._drop_certifier(unit)
         if not unit.escalated:
             # final=True: a spilled unit's WAL rebuild must apply the
             # same end-of-history settle the live encoder just did —

@@ -27,3 +27,12 @@ pin_cpu(8)
 # with monkeypatched env + a tmp plan store. JGRAFT_AUTOTUNE=0 is the
 # documented "today's exact behavior" switch.
 os.environ.setdefault("JGRAFT_AUTOTUNE", "0")
+
+# Lin-rung fast path (ISSUE 14) off by default under pytest, same
+# stance: with it on, every valid lin-rung row decides as
+# greedy-witness on the host and the kernel-path tests (chunk stats,
+# coalescing, kernel tags) would never see a launch. Tests of the fast
+# path itself (tests/test_lin_fastpath.py, service fast-lane tests)
+# opt back in with monkeypatched env. JGRAFT_LIN_FASTPATH=0 is the
+# documented force-disable/A-B arm; production default stays ON.
+os.environ.setdefault("JGRAFT_LIN_FASTPATH", "0")

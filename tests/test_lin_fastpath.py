@@ -1,0 +1,544 @@
+"""Linearizable-rung pre-kernel fast path (ISSUE 14): verdict-identity
+differential matrix, @lin tier attribution, the weak-rung double-scan
+skip, measured per-bucket gating, the certify abort budget, and the
+graftd dispatch fast lane.
+
+The suite opts INTO the fast path per test (tests/conftest.py pins
+``JGRAFT_LIN_FASTPATH=0`` so the kernel-path suites keep seeing
+launches); ``JGRAFT_AUTOTUNE`` stays 0 except in the gating tests, so
+no host-dependent gate state leaks between tests.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker import autotune
+from jepsen_jgroups_raft_tpu.checker.base import INVALID, VALID
+from jepsen_jgroups_raft_tpu.checker.consistency import (
+    StreamingCertifier, certify_encoded)
+from jepsen_jgroups_raft_tpu.checker.linearizable import (
+    check_encoded, check_encoded_host, check_histories,
+    consume_fastpath_counters, fastpath_counters)
+from jepsen_jgroups_raft_tpu.checker.schedule import (consume_tiers,
+                                                      snapshot_tiers)
+from jepsen_jgroups_raft_tpu.history.ops import History, Op
+from jepsen_jgroups_raft_tpu.history.packing import encode_history
+from jepsen_jgroups_raft_tpu.models import (CasRegister, Counter, GSet,
+                                            TicketQueue)
+
+from util import H, corrupt, random_valid_history
+
+MODELS = {
+    "register": CasRegister,   # covers the register AND cas op mix
+    "counter": Counter,
+    "set": GSet,
+    "queue": TicketQueue,
+}
+
+
+def poisoned(h: History) -> History:
+    """Append write w1; write w2; read w1 — all sequential on one fresh
+    process — making the history INVALID at every rung (program order
+    alone refutes it) while the certifier still scans the whole stream
+    before coming up undecided: the fast path's worst case."""
+    ops = list(h)
+    t = max((op.time for op in ops), default=0) + 1
+    p = 9999
+    for i, (f, v, typ) in enumerate((
+            ("write", 777001, "invoke"), ("write", 777001, "ok"),
+            ("write", 777002, "invoke"), ("write", 777002, "ok"),
+            ("read", None, "invoke"), ("read", 777001, "ok"))):
+        ops.append(Op(process=p, type=typ, f=f, value=v, time=t + i))
+    return History(ops)
+
+
+def mixed_batch(kind: str, n: int = 8, n_ops: int = 40) -> list:
+    """Valid + corrupted histories for one family (both polarities)."""
+    rng = random.Random(11)
+    out = []
+    for i in range(n):
+        h = random_valid_history(rng, kind, n_ops=n_ops, n_procs=4,
+                                 crash_p=0.05, max_crashes=2)
+        out.append(corrupt(rng, h) if i % 3 == 0 else h)
+    return out
+
+
+# ------------------------------------------------- differential matrix
+
+
+@pytest.mark.parametrize("kind", sorted(MODELS))
+@pytest.mark.parametrize("macro", ["1", "0"])
+@pytest.mark.parametrize("chunk", ["128", "0"])
+def test_fastpath_verdict_identity_matrix(kind, macro, chunk,
+                                          monkeypatch):
+    """ISSUE-14 soundness gate: verdicts bitwise-identical fast path on
+    vs force-disabled, across all model families x macro on/off x
+    chunked/monolithic, with both polarities in the batch."""
+    monkeypatch.setenv("JGRAFT_MACRO_EVENTS", macro)
+    monkeypatch.setenv("JGRAFT_SCAN_CHUNK", chunk)
+    model = MODELS[kind]()
+    hists = mixed_batch(kind)
+    verdicts = {}
+    for fp in ("1", "0"):
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", fp)
+        verdicts[fp] = [r["valid?"] for r in
+                        check_histories(hists, model, algorithm="jax")]
+    assert verdicts["1"] == verdicts["0"], verdicts
+    assert True in verdicts["1"] and False in verdicts["1"]
+
+
+def test_fastpath_results_carry_lin_namespaced_tier(monkeypatch):
+    """Certified rows attribute ``greedy@lin``/``backtrack@lin`` —
+    never the weak-rung certifier's bare greedy/backtrack — end to end
+    through the result dicts and the note_tier counters."""
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    rng = random.Random(5)
+    m = CasRegister()
+    hists = [random_valid_history(rng, "register", n_ops=60, n_procs=4,
+                                  crash_p=0.05, max_crashes=2)
+             for _ in range(8)]
+    consume_tiers()
+    consume_fastpath_counters()
+    rs = check_histories(hists, m, algorithm="jax")
+    certified = [r for r in rs if r["algorithm"] == "greedy-witness"]
+    assert certified, "fast path never engaged on a valid batch"
+    for r in certified:
+        assert r["decided-tier"] in ("greedy@lin", "backtrack@lin"), r
+    tiers = snapshot_tiers()
+    assert set(tiers) & {"greedy@lin", "backtrack@lin"}
+    assert "greedy" not in tiers and "backtrack" not in tiers
+    c = fastpath_counters()
+    assert c["rows_certified"] == len(certified)
+    assert c["rows_scanned"] == len(hists)
+
+
+def test_trivial_rows_keep_trivial_tier(monkeypatch):
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    m = CasRegister()
+    [r] = check_encoded([encode_history(H(), m)], m, algorithm="jax")
+    assert r["decided-tier"] == "trivial"
+
+
+def test_explicit_cpu_algorithm_keeps_its_engine(monkeypatch):
+    """"cpu"/"dfs" are oracle selectors — the fast path only fronts
+    the kernel-launching algorithms."""
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    rng = random.Random(5)
+    m = CasRegister()
+    h = random_valid_history(rng, "register", n_ops=30, crash_p=0.0)
+    [r] = check_histories([h], m, algorithm="cpu")
+    assert r["algorithm"] == "cpu"
+    [r] = check_histories([h], m, algorithm="dfs")
+    assert r["algorithm"] == "dfs"
+
+
+# --------------------------------------------- weak-rung double-scan
+
+
+def test_weak_rung_reentry_skips_second_scan(monkeypatch):
+    """ISSUE-14 satellite: rows the rung certifier already failed to
+    certify re-enter check_encoded at the lin rung with the fast path
+    suppressed — the counter proves the skip fires, and the redundant
+    scan counter proves nothing was scanned twice."""
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    # cycle tier off: the poisoned history is cycle-refutable, which
+    # would decide it BEFORE the kernel re-entry this test pins
+    monkeypatch.setenv("JGRAFT_CYCLE_TIER", "0")
+    m = CasRegister()
+    # sequential-INVALID (program order alone refutes it), so the rung
+    # certifier fails on both streams and the kernel re-entry happens
+    bad = poisoned(random_valid_history(random.Random(2), "register",
+                                        n_ops=20, crash_p=0.0))
+    consume_fastpath_counters()
+    rs = check_histories([bad], m, algorithm="jax",
+                         consistency="sequential")
+    assert rs[0]["valid?"] is INVALID
+    c = consume_fastpath_counters()
+    assert c["rows_rung_skipped"] == 1
+    assert c["rows_scanned"] == 0  # the lin pass never re-scanned
+    # with the fast path force-disabled there is no scan to save: the
+    # counter must stay silent (a JGRAFT_LIN_FASTPATH=0 ablation run's
+    # stored results must not claim fast-path engagement)
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "0")
+    check_histories([bad], m, algorithm="jax",
+                    consistency="sequential")
+    assert consume_fastpath_counters()["rows_rung_skipped"] == 0
+
+
+# ------------------------------------------------------- abort budget
+
+
+def test_certify_abort_budget_returns_undecided_never_wrong():
+    m = CasRegister()
+    rng = random.Random(7)
+    h = random_valid_history(rng, "register", n_ops=40, crash_p=0.05)
+    enc = encode_history(h.client_ops(), m)
+    assert certify_encoded(enc, m)[0] is True
+    ok, tier, _ = certify_encoded(enc, m, max_steps=2)
+    assert ok is False and tier is None
+
+
+def test_tiny_abort_budget_keeps_verdicts_identical(monkeypatch):
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH_ABORT", "1")
+    m = CasRegister()
+    hists = mixed_batch("register")
+    rs = check_histories(hists, m, algorithm="jax")
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "0")
+    ref = check_histories(hists, m, algorithm="jax")
+    assert [r["valid?"] for r in rs] == [r["valid?"] for r in ref]
+
+
+# ------------------------------------------------------ gating (autotune)
+
+
+def test_low_hit_bucket_routes_kernel_first(monkeypatch, tmp_path):
+    """ISSUE-14 acceptance satellite: a seeded low-hit bucket (all
+    rows uncertifiable) trains the measured gate; later batches route
+    kernel-first (rows_gated fires, nothing scanned) with verdicts
+    unchanged, and the record is persisted in the host-fingerprinted
+    store."""
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    monkeypatch.setenv("JGRAFT_AUTOTUNE", "1")
+    monkeypatch.setenv("JGRAFT_AUTOTUNE_STORE", str(tmp_path))
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH_MIN_OBS", "8")
+    autotune.reset_for_tests()
+    m = CasRegister()
+    rng = random.Random(9)
+    # one uncertifiable history, repeated: every row lands in ONE
+    # gating bucket, so the 8-row batch crosses MIN_OBS in one run
+    hists = [poisoned(random_valid_history(rng, "register", n_ops=20,
+                                           crash_p=0.0))] * 8
+    consume_fastpath_counters()
+    rs1 = check_histories(hists, m, algorithm="jax")
+    c1 = consume_fastpath_counters()
+    assert c1["rows_scanned"] == 8 and c1["rows_certified"] == 0
+    # the record landed in the fingerprint store
+    files = list((tmp_path / autotune.host_fingerprint()).glob(
+        "linfp-*.json"))
+    assert files, "gating record was not persisted"
+    sig = autotune.lin_fastpath_sig(
+        "CasRegister",
+        encode_history(hists[0].client_ops(), m).n_events)
+    assert autotune.lin_fastpath_route(sig) is False
+    rs2 = check_histories(hists, m, algorithm="jax")
+    c2 = consume_fastpath_counters()
+    assert c2["rows_gated"] == 8 and c2["rows_scanned"] == 0
+    assert [r["valid?"] for r in rs1] == [r["valid?"] for r in rs2]
+    assert all(r["valid?"] is INVALID for r in rs2)
+    # a fresh in-memory state reloads the persisted record (the
+    # cross-process half of the gate)
+    autotune.reset_for_tests()
+    assert autotune.lin_fastpath_route(sig) is False
+
+
+def test_gating_off_without_autotune(monkeypatch, tmp_path):
+    """JGRAFT_AUTOTUNE=0 (the deterministic-test arm): the fast path
+    always tries and persists nothing."""
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    monkeypatch.setenv("JGRAFT_AUTOTUNE", "0")
+    monkeypatch.setenv("JGRAFT_AUTOTUNE_STORE", str(tmp_path))
+    m = CasRegister()
+    sig = autotune.lin_fastpath_sig("CasRegister", 40)
+    autotune.lin_fastpath_observe(sig, rows=100, hits=0, wall_s=0.1)
+    assert autotune.lin_fastpath_route(sig) is True
+    assert not list(tmp_path.glob("**/linfp-*.json"))
+
+
+# ------------------------------------------------------- host ladder
+
+
+def test_check_encoded_host_fastpath(monkeypatch):
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    m = CasRegister()
+    good = encode_history(random_valid_history(
+        random.Random(1), "register", n_ops=20,
+        crash_p=0.0).client_ops(), m)
+    r = check_encoded_host(good, m)
+    assert r["valid?"] is VALID
+    assert r["decided-tier"] in ("greedy@lin", "backtrack@lin")
+    # suppressed: the graftd fast lane already tried at dispatch
+    r2 = check_encoded_host(good, m, lin_fastpath=False)
+    assert r2["valid?"] is VALID and r2["decided-tier"] == "host"
+    bad = encode_history(H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "write", 2), (0, "ok", "write", 2),
+        (1, "invoke", "read", None), (1, "ok", "read", 1),
+    ), m)
+    rb = check_encoded_host(bad, m)
+    assert rb["valid?"] is INVALID and rb["decided-tier"] == "host"
+
+
+# ------------------------------------------- resumable certifier (unit)
+
+
+class TestStreamingCertifier:
+    def _feed_cuts(self, model, enc, cuts_rng):
+        sc = StreamingCertifier(model)
+        ev, lo = enc.events, 0
+        while lo < ev.shape[0]:
+            hi = min(ev.shape[0], lo + cuts_rng.randint(1, 16))
+            sc.feed(ev[lo:hi])
+            lo = hi
+        return sc
+
+    @pytest.mark.parametrize("kind", sorted(MODELS))
+    def test_certifies_valid_streams_across_random_cuts(self, kind):
+        rng = random.Random(17)
+        model = MODELS[kind]()
+        for _ in range(4):
+            h = random_valid_history(rng, kind, n_ops=40, n_procs=4,
+                                     crash_p=0.05, max_crashes=2)
+            enc = encode_history(h.client_ops(), model, prune=False)
+            one_shot = certify_encoded(enc, model)[0]
+            sc = self._feed_cuts(model, enc, rng)
+            if one_shot:
+                # the incremental scan may spend flips the one-shot
+                # does not (op_forced is learned late), but a
+                # certified prefix must stay certified
+                assert sc.certified, kind
+                assert sc.tier in ("greedy", "backtrack")
+                assert sc.carry_state()["pos"] == enc.n_events
+
+    def test_incremental_cost_is_per_segment(self):
+        """The resumable carry's point: a later append pays O(segment)
+        step calls, not the per-append restart's O(history)."""
+        m = CasRegister()
+        calls = [0]
+        raw = m.step
+
+        def counting(state, f, a, b):
+            calls[0] += 1
+            return raw(state, f, a, b)
+
+        m.step = counting
+        rows = []
+        for j in range(200):
+            rows += [(0, "invoke", "write", j), (0, "ok", "write", j)]
+        enc = encode_history(H(*rows), CasRegister(), prune=False)
+        sc = StreamingCertifier(m)
+        seg = enc.n_events // 10
+        per_feed = []
+        for lo in range(0, enc.n_events, seg):
+            calls[0] = 0
+            assert sc.feed(enc.events[lo:lo + seg])
+            per_feed.append(calls[0])
+        # every feed costs ~its own segment; a restarting certifier's
+        # LAST feed alone would pay >= the whole stream's step count
+        assert max(per_feed[1:]) <= 4 * seg
+        assert sum(per_feed) < 2 * enc.n_events + 4 * seg
+
+    def test_undecided_is_permanent(self):
+        m = CasRegister()
+        bad = poisoned(H((0, "invoke", "write", 1),
+                         (0, "ok", "write", 1)))
+        enc = encode_history(bad.client_ops(), m, prune=False)
+        sc = StreamingCertifier(m, budget=0)
+        certified = True
+        for lo in range(0, enc.n_events, 4):
+            certified = sc.feed(enc.events[lo:lo + 4])
+        assert certified is False and sc.certified is False
+        assert sc.tier is None
+        # feeding more can never resurrect a dead certifier
+        assert sc.feed(enc.events[:0]) is False
+
+
+# --------------------------------------------------- graftd fast lane
+
+
+class TestServiceFastLane:
+    def _service(self, **kw):
+        from jepsen_jgroups_raft_tpu.service import CheckingService
+
+        return CheckingService(store_root=None, **kw)
+
+    def test_certifiable_request_skips_the_batch_path(self, monkeypatch):
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        svc = self._service()
+        try:
+            h = random_valid_history(random.Random(3), "register",
+                                     n_ops=24, crash_p=0.0)
+            req = svc.submit([h], workload="register")
+            assert req.wait(30)
+            assert req.verdict() is True
+            assert req.stats.get("fastlane") is True
+            assert sum(req.stats["decided_tier"].values()) == 1
+            assert set(req.stats["decided_tier"]) <= {
+                "greedy@lin", "backtrack@lin"}
+            st = svc.stats()
+            assert st["fastpath_requests"] == 1
+            assert st["batches"] == 0          # never a batch slot
+            assert st["completed"] == 1
+            assert set(st["decided_tier"]) <= {
+                "greedy@lin", "backtrack@lin"}
+            # clean fast-lane verdicts are cacheable: an identical
+            # resubmission answers from the fingerprint cache
+            req2 = svc.submit([h], workload="register")
+            assert req2.wait(30) and req2.cached
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_undecidable_request_still_batches(self, monkeypatch):
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        svc = self._service()
+        try:
+            bad = poisoned(random_valid_history(random.Random(4),
+                                                "register", n_ops=16,
+                                                crash_p=0.0))
+            req = svc.submit([bad], workload="register")
+            assert req.wait(60)
+            assert req.verdict() is False
+            assert not req.stats.get("fastlane")
+            st = svc.stats()
+            assert st["fastpath_requests"] == 0
+            assert st["batches"] >= 1
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_partial_certify_never_double_counts_tiers(self,
+                                                       monkeypatch):
+        """Review fix: a partially-certifiable request's discarded
+        fast-lane results must not tier-attribute rows the kernel then
+        attributes again — decided fractions would exceed 1.0."""
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        svc = self._service()
+        try:
+            good = random_valid_history(random.Random(7), "register",
+                                        n_ops=16, crash_p=0.0)
+            bad = poisoned(random_valid_history(random.Random(8),
+                                                "register", n_ops=16,
+                                                crash_p=0.0))
+            consume_tiers()
+            req = svc.submit([good, bad], workload="register")
+            assert req.wait(60)
+            assert req.verdict() is False
+            assert not req.stats.get("fastlane")
+            tiers = consume_tiers()
+            decided = sum(v["rows"] for v in tiers.values())
+            assert decided == 2, tiers  # one attribution per row
+            assert not set(tiers) & {"greedy@lin", "backtrack@lin"}, \
+                tiers
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_cancel_during_lane_scan_is_honored(self, monkeypatch):
+        """Review fix: a cancel landing DURING the host certify scan
+        must finalize CANCELLED, not DONE — matching the batch path's
+        honor-cancel-at-demux contract."""
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        from jepsen_jgroups_raft_tpu.service.admission import \
+            AdmissionQueue
+        from jepsen_jgroups_raft_tpu.service.request import (CANCELLED,
+                                                             admit)
+        from jepsen_jgroups_raft_tpu.service.scheduler import \
+            BatchScheduler
+
+        req = admit([random_valid_history(random.Random(3), "register",
+                                          n_ops=16, crash_p=0.0)],
+                    "register")
+        raw = req.model.step
+
+        def cancelling(state, f, a, b):
+            req.cancelled.set()   # the tenant cancels mid-scan
+            return raw(state, f, a, b)
+
+        req.model.step = cancelling
+        sched = BatchScheduler(AdmissionQueue())
+        decided, live = sched.fastlane([req])
+        assert decided == [req] and not live
+        assert req.status == CANCELLED
+        assert req.results is None
+
+    def test_trivial_rows_do_not_block_the_lane(self, monkeypatch):
+        """Review fix: a request carrying an empty (0-event) history
+        is still fast-laned — empty rows are host-decidable for free
+        and must not push the request onto the batch path."""
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        svc = self._service()
+        try:
+            good = random_valid_history(random.Random(3), "register",
+                                        n_ops=16, crash_p=0.0)
+            req = svc.submit([H(), good], workload="register")
+            assert req.wait(30)
+            assert req.verdict() is True
+            assert req.stats.get("fastlane") is True
+            assert req.results[0]["decided-tier"] == "trivial"
+            assert req.results[1]["decided-tier"] in ("greedy@lin",
+                                                      "backtrack@lin")
+            st = svc.stats()
+            assert st["fastpath_requests"] == 1 and st["batches"] == 0
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_lane_skipped_requests_keep_host_ladder_fastpath(
+            self, monkeypatch):
+        """Review fix: execute() suppresses the in-checker fast path
+        only for requests the lane actually SCANNED — a force_host
+        watchdog retry (lane-skipped) still gets the host ladder's
+        pre-frontier certify pass."""
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        from jepsen_jgroups_raft_tpu.service.admission import \
+            AdmissionQueue
+        from jepsen_jgroups_raft_tpu.service.request import admit
+        from jepsen_jgroups_raft_tpu.service.scheduler import \
+            BatchScheduler
+
+        req = admit([random_valid_history(random.Random(3), "register",
+                                          n_ops=16, crash_p=0.0)],
+                    "register")
+        req.force_host = True   # watchdog second strike
+        sched = BatchScheduler(AdmissionQueue())
+        decided, live = sched.fastlane([req])
+        assert not decided and live == [req]   # lane skipped, no scan
+        sched.execute(live)
+        assert req.verdict() is True
+        # the degrade arm's host ladder ran ITS fast path: the verdict
+        # was certified, not frontier-searched
+        assert req.results[0]["decided-tier"] in ("greedy@lin",
+                                                  "backtrack@lin")
+        assert req.results[0]["platform-degraded"]
+
+    def test_lane_disabled_for_injected_check_fn(self, monkeypatch):
+        """An injected check_fn is a seam that must observe every
+        batch — the lane never short-circuits it."""
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+        from jepsen_jgroups_raft_tpu.checker.linearizable import \
+            check_encoded as real_check
+        seen = []
+
+        def spying(encs, model, algorithm="auto",
+                   consistency="linearizable"):
+            seen.append(len(encs))
+            return real_check(encs, model, algorithm=algorithm,
+                              consistency=consistency,
+                              lin_fastpath=False)
+
+        svc = self._service(check_fn=spying)
+        try:
+            h = random_valid_history(random.Random(5), "register",
+                                     n_ops=24, crash_p=0.0)
+            req = svc.submit([h], workload="register")
+            assert req.wait(30)
+            assert req.verdict() is True
+            assert seen == [1]
+            assert svc.stats()["fastpath_requests"] == 0
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_lane_off_with_env_disable(self, monkeypatch):
+        monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "0")
+        svc = self._service()
+        try:
+            h = random_valid_history(random.Random(6), "register",
+                                     n_ops=24, crash_p=0.0)
+            req = svc.submit([h], workload="register")
+            assert req.wait(30)
+            assert req.verdict() is True
+            st = svc.stats()
+            assert st["fastpath_requests"] == 0
+            assert st["batches"] >= 1
+        finally:
+            svc.shutdown(wait=True)

@@ -425,11 +425,11 @@ class TestWorkerResilience:
         orig = svc.scheduler.next_batch
         tripped = threading.Event()
 
-        def bomb(timeout):
+        def bomb(timeout, **kw):
             if not tripped.is_set():
                 tripped.set()
                 raise RuntimeError("injected worker death")
-            return orig(timeout)
+            return orig(timeout, **kw)
 
         svc.scheduler.next_batch = bomb
         # Wait for the bomb to actually kill the worker BEFORE

@@ -249,7 +249,7 @@ class TestStreamVerdictIdentity:
             fin, _ = _stream_whole(svc, h, "register", n_segments=5)
             assert fin["valid?"] is VALID
             assert fin["results"][0]["algorithm"] == "greedy-witness"
-            assert fin["results"][0]["decided-tier"] == "greedy"
+            assert fin["results"][0]["decided-tier"] == "greedy@lin"
         finally:
             svc.shutdown(wait=True)
 
@@ -285,7 +285,7 @@ class TestStreamVerdictIdentity:
                     break
             assert target is not None, "no ambiguous-but-certifiable seed"
             assert fin["valid?"] is VALID
-            assert fin["results"][0]["decided-tier"] == "backtrack"
+            assert fin["results"][0]["decided-tier"] == "backtrack@lin"
             # PR-9 ablation arm: same session, backtracking off — the
             # greedy path drops it and the carried kernel answers, with
             # the SAME verdict (the wiring never changes verdicts).
@@ -498,6 +498,101 @@ class TestIdleAndResume:
 
 
 # -------------------------------------------------- crash resume identity
+
+
+class TestResumableCertifier:
+    """ISSUE 14: the per-append greedy no longer restarts from op 0 —
+    the certifier's (state, done-set, pending, backtrack frame) carry
+    persists between appends and is rebuilt deterministically on
+    replay, exactly like `CarriedScan`'s {inner, left}."""
+
+    def test_resumed_certifier_carry_equals_uninterrupted(self,
+                                                          tmp_path):
+        """Interrupt a session mid-stream; the revived session's
+        certifier carry must equal the uninterrupted session's
+        FIELD-FOR-FIELD after the same appends, and both must finish
+        with the same certified verdict."""
+        h = random_valid_history(random.Random(31), "register",
+                                 n_ops=40, crash_p=0.1)
+        segs = _segments(h, 4)
+
+        svc_a = _service(tmp_path / "uninterrupted")
+        svc_a.streams.open(workload="register", session_id="s")
+        for i, seg in enumerate(segs, start=1):
+            svc_a.streams.append("s", i, seg, n_bytes=64)
+        unit_a = svc_a.streams._get("s").units[0]
+        assert unit_a.certifier is not None and unit_a.certified
+        carry_a = unit_a.certifier.carry_state()
+
+        root_b = tmp_path / "interrupted"
+        svc_b = _service(root_b)
+        svc_b.streams.open(workload="register", session_id="s")
+        for i, seg in enumerate(segs[:2], start=1):
+            svc_b.streams.append("s", i, seg, n_bytes=64)
+        svc_b.shutdown(wait=True)   # streams survive by design
+
+        svc_c = _service(root_b)
+        for i, seg in enumerate(segs[2:], start=3):
+            svc_c.streams.append("s", i, seg, n_bytes=64)
+        unit_c = svc_c.streams._get("s").units[0]
+        assert unit_c.certifier is not None
+        assert unit_c.certifier.carry_state() == carry_a
+        fin_a = svc_a.streams.finish("s")
+        fin_c = svc_c.streams.finish("s")
+        assert fin_a["results"][0] == fin_c["results"][0]
+        assert fin_c["results"][0]["decided-tier"] in (
+            "greedy@lin", "backtrack@lin")
+        svc_a.shutdown(wait=True)
+        svc_c.shutdown(wait=True)
+
+    def test_append_does_not_rescan_the_prefix(self, tmp_path):
+        """The O(segment) claim at the session surface: the model's
+        step() call count per append stays bounded by the segment, not
+        the accumulated history."""
+        rows = []
+        for j in range(120):
+            rows += [(0, "invoke", "write", j), (0, "ok", "write", j)]
+        h = build_history(rows)
+        svc = _service(tmp_path)
+        try:
+            st = svc.streams.open(workload="register")
+            sid = st["session"]
+            sess = svc.streams._get(sid)
+            calls = [0]
+            raw = sess.model.step
+
+            def counting(state, f, a, b):
+                calls[0] += 1
+                return raw(state, f, a, b)
+
+            sess.model.step = counting
+            segs = _segments(h, 8)
+            per_append = []
+            for i, seg in enumerate(segs, start=1):
+                calls[0] = 0
+                svc.streams.append(sid, i, seg, n_bytes=64)
+                per_append.append(calls[0])
+            unit = sess.units[0]
+            assert unit.certified
+            seg_events = 2 * len(segs[0])
+            # a restarting certifier's later appends would each pay
+            # >= the whole accumulated stream (~240 events)
+            assert max(per_append[1:]) <= 4 * seg_events
+        finally:
+            svc.shutdown(wait=True)
+
+    def test_undecided_certifier_hands_to_kernel_once(self, tmp_path):
+        """Once the certifier goes undecided it is dropped (dead
+        certifiers never un-decide) and the carried kernel owns the
+        unit — same verdict as the one-shot path."""
+        h = _impossible_register_history()
+        svc = _service(tmp_path)
+        try:
+            fin, _ = _stream_whole(svc, h, "register", 3)
+            [ref] = check_histories([h.client_ops()], CasRegister())
+            assert fin["valid?"] is ref["valid?"] is INVALID
+        finally:
+            svc.shutdown(wait=True)
 
 
 class TestCrashResume:
