@@ -430,8 +430,18 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         lo, hi = 0, n_histories
     # Per-host encode: only this shard's rows ride the (host-dominant)
     # encode pass; synthesis stays global so every process agrees on
-    # the batch without exchanging histories.
+    # the batch without exchanging histories. encode_wall_s /
+    # fp_hash_wall_s land in the row (ISSUE 15): the re-anchor needs to
+    # see where HOST wall lives now that most verdicts skip kernels.
+    t0 = time.perf_counter()
     encs = [encode_history(h, model) for h in histories[lo:hi]]
+    encode_wall_s = time.perf_counter() - t0
+    from jepsen_jgroups_raft_tpu.service.request import \
+        fingerprint_encodings
+
+    t0 = time.perf_counter()
+    fingerprint_encodings(model, "jax", encs)
+    fp_hash_wall_s = time.perf_counter() - t0
     n_slots = bucket_slots(max((e.n_slots for e in encs), default=1))
     mesh = local_mesh() if dist_on else make_mesh()
 
@@ -638,6 +648,11 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         "time_s": round(dt, 3),
         "pack_time_s": round(dt_pack, 3),
         "kernel_time_s": round(dt_kernel, 3),
+        # ISSUE-15 host-path phase walls (this shard's encode pass and
+        # one fingerprint hash over its encodings — both OUTSIDE the
+        # timed reps, priced once so host share is auditable).
+        "encode_wall_s": round(encode_wall_s, 6),
+        "fp_hash_wall_s": round(fp_hash_wall_s, 6),
         # Multi-host placement (ISSUE 7): n_processes = cluster size
         # (1 single-process); per_host_pack_s = THIS host's shard pack
         # wall (== pack_time_s; named so cross-process rows are
@@ -771,6 +786,11 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             "lin_fastpath_rung_skipped_rows": fp["rows_rung_skipped"],
             "lin_fastpath_certify_wall_s": round(
                 fp["certify_wall_s"], 4),
+            # ISSUE-15: certifier throughput over the scanned events
+            # (the batched-core evidence; 0.0 when nothing scanned)
+            "certify_events_per_s": round(
+                fp["events_scanned"] / fp["certify_wall_s"], 1)
+            if fp["certify_wall_s"] else 0.0,
             "lin_fastpath_verdicts_identical": identical,
             "decided_by_tier": {k: v["rows"]
                                 for k, v in tiers_on.items()},
@@ -833,8 +853,24 @@ def run_suite(platform_note: str) -> None:
         return max(floor, int(n * scale))
 
     def timed(name, model, hists, model_family=None, consistency=None):
+        from jepsen_jgroups_raft_tpu.checker.linearizable import \
+            consume_fastpath_counters
         from jepsen_jgroups_raft_tpu.checker.schedule import (consume_stats,
                                                               consume_tiers)
+        from jepsen_jgroups_raft_tpu.history.packing import encode_history
+        from jepsen_jgroups_raft_tpu.service.request import \
+            fingerprint_encodings
+
+        # ISSUE-15 host-path phase walls, priced once OUTSIDE the timed
+        # reps (check_histories re-encodes internally; these fields
+        # document where HOST wall lives at this config's shape).
+        t0 = time.perf_counter()
+        encs_once = [encode_history(h, model) for h in hists]
+        encode_wall_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fingerprint_encodings(model, "jax", encs_once)
+        fp_hash_wall_s = time.perf_counter() - t0
+        del encs_once
 
         # No pinned capacity: the checker auto-routes (dense kernel where
         # the domain allows, capacity-laddered sort kernel otherwise).
@@ -847,6 +883,7 @@ def run_suite(platform_note: str) -> None:
         beat()
         consume_stats()  # drop the warm-up's chunked-scan counters
         consume_tiers()
+        consume_fastpath_counters()  # and its lin-fastpath counters
         # Best-of-3 like the north-star bench: single-shot suite rows
         # measured the tunnel's mood (config 4 read 3.08 hist/s in the
         # same session a warm in-process A/B measured 9.5).
@@ -855,6 +892,7 @@ def run_suite(platform_note: str) -> None:
         dt = min(times)
         scan = consume_stats()  # summed over the timed reps
         tiers = consume_tiers()
+        fp = consume_fastpath_counters()  # summed over the timed reps
         # ISSUE 13 per-tier attribution: decided rows come from the
         # LAST rep's verdicts (one batch's worth — deterministic);
         # per-tier wall is the timed reps' sum (overlap caveats as the
@@ -877,6 +915,14 @@ def run_suite(platform_note: str) -> None:
                                    for k, v in by_tier.items()},
               "tier_wall_s": {k: round(v["wall_s"], 4)
                               for k, v in tiers.items()},
+              # ISSUE-15 host-path phase fields: where host wall lives
+              # at this shape (encode + fingerprint once, untimed; the
+              # certifier throughput over the timed reps' scans).
+              "encode_wall_s": round(encode_wall_s, 6),
+              "fp_hash_wall_s": round(fp_hash_wall_s, 6),
+              "certify_events_per_s": round(
+                  fp["events_scanned"] / fp["certify_wall_s"], 1)
+              if fp["certify_wall_s"] else 0.0,
               "rep_times_s": [round(t, 3) for t in times],
               **cold_warm(times),
               "evicted_rows": scan["evicted_rows"],
@@ -1163,6 +1209,49 @@ def run_service(platform_note: str) -> None:
             "fastpath_requests": fp_reqs,
         }
 
+    # ISSUE-15 group-commit A/B: same daemon, same payload pool, WAL
+    # group commit on (default linger) vs JGRAFT_JOURNAL_GROUP_MS=0
+    # (per-append write+fsync — today's exact behavior), interleaved
+    # in THIS process; the knob is resolved per append, so one live
+    # daemon serves both arms. Empty when the journal is off or
+    # JGRAFT_SERVICE_BENCH_GROUPAB=0 skips the phase.
+    group_fields: dict = {}
+    if journal_enabled() and os.environ.get(
+            "JGRAFT_SERVICE_BENCH_GROUPAB", "1") != "0":
+        prior_g = os.environ.get("JGRAFT_JOURNAL_GROUP_MS")
+        times_ab: dict = {True: [], False: []}
+        try:
+            for rep in range(2):       # interleaved, order rotated
+                order = (True, False) if rep % 2 == 0 else (False, True)
+                for on in order:
+                    if on:
+                        os.environ.pop("JGRAFT_JOURNAL_GROUP_MS", None)
+                    else:
+                        os.environ["JGRAFT_JOURNAL_GROUP_MS"] = "0"
+                    w, _, _, _ = wave()
+                    times_ab[on].append(w)
+                    beat()
+        finally:
+            if prior_g is None:
+                os.environ.pop("JGRAFT_JOURNAL_GROUP_MS", None)
+            else:
+                os.environ["JGRAFT_JOURNAL_GROUP_MS"] = prior_g
+        group_fields = {
+            "journal_group_on_req_s": round(
+                n_requests / min(times_ab[True]), 2),
+            "journal_group_off_req_s": round(
+                n_requests / min(times_ab[False]), 2),
+            "journal_group_speedup": round(
+                min(times_ab[False]) / min(times_ab[True]), 3),
+        }
+    # Group-commit gauges only: taken AFTER the A/B phases (they are
+    # process-lifetime counters, so later is more complete), but kept
+    # out of `stats` — the row's journal_append_p50_ms /
+    # recovered_requests must keep describing the MAIN timed run, and
+    # append_ms is a last-4096 window the A/B waves (half of them
+    # per-append-fsync arms) would contaminate.
+    gstats = service.stats()
+
     httpd.shutdown()
     httpd.server_close()
     service.shutdown(wait=True)
@@ -1210,6 +1299,15 @@ def run_service(platform_note: str) -> None:
         # same-process via JGRAFT_SERVICE_JOURNAL=0.
         "journal_enabled": stats["journal_enabled"],
         "journal_append_p50_ms": stats.get("journal_append_p50_ms"),
+        # ISSUE-15 group-commit evidence: the linger window, how many
+        # fsyncs the WAL issued, records per fsync, and the
+        # same-process on/off A/B req/s (group_fields; empty when the
+        # journal is off or the phase is skipped).
+        "journal_group_ms": gstats.get("journal_group_ms"),
+        "journal_group_commits": gstats.get("journal_group_commits"),
+        "journal_group_occupancy_mean": gstats.get(
+            "journal_group_occupancy_mean"),
+        **group_fields,
         "recovered_requests": stats["recovered_requests"],
         # ISSUE-13 tier attribution (process-lifetime gauge like the
         # health counters): which decision-ladder tier decided the

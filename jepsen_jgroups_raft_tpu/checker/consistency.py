@@ -792,22 +792,32 @@ def apply_rung(encs: Sequence[EncodedHistory], model, consistency: str):
     relax and retry (the relaxed stream admits rung-only witnesses,
     e.g. stale reads); rows still undecided go to the kernels on the
     relaxed stream."""
+    from .certify_batch import certify_many
+
     consistency = normalize_consistency(consistency)
     n = len(encs)
     out: list = list(encs)
     certified = [False] * n
     tiers: list = [None] * n
     greedy = greedy_on()
-    for i, e in enumerate(encs):
-        if greedy and e.n_events > 0:
-            ok, tier, _ = certify_encoded(e, model)
-            if ok:
-                certified[i] = True
-                tiers[i] = tier
-                continue
-        out[i] = relax_encoded(e, model, consistency)
-        if greedy and out[i].n_events > 0:
-            ok, tier, _ = certify_encoded(out[i], model)
+    # Pass 1: certify the ORIGINAL streams, batched across the rows
+    # (checker/certify_batch.py — outcome-identical to the per-row
+    # scalar loop; JGRAFT_CERTIFY_BATCH=0 restores it exactly).
+    first = ([i for i in range(n) if encs[i].n_events > 0]
+             if greedy else [])
+    res = certify_many([encs[i] for i in first], model)
+    for i, (ok, tier, _) in zip(first, res):
+        if ok:
+            certified[i] = True
+            tiers[i] = tier
+    # Pass 2: relax the misses and retry on the rung's stream.
+    retry = [i for i in range(n) if not certified[i]]
+    for i in retry:
+        out[i] = relax_encoded(encs[i], model, consistency)
+    if greedy:
+        retry = [i for i in retry if out[i].n_events > 0]
+        res = certify_many([out[i] for i in retry], model)
+        for i, (ok, tier, _) in zip(retry, res):
             if ok:
                 certified[i] = True
                 tiers[i] = tier

@@ -132,7 +132,8 @@ def lin_abort_steps() -> int:
 
 _FP_LOCK = threading.Lock()
 _FP_ZERO = {"rows_scanned": 0, "rows_certified": 0, "rows_gated": 0,
-            "rows_rung_skipped": 0, "certify_wall_s": 0.0}
+            "rows_rung_skipped": 0, "events_scanned": 0,
+            "certify_wall_s": 0.0}
 _FP_COUNTERS = dict(_FP_ZERO)
 
 
@@ -178,7 +179,7 @@ def lin_fastpath_pass(encs: Sequence[EncodedHistory], model,
     the requests it delivers. The `fastpath_counters` bumps stay
     unconditional: rows_scanned/rows_certified count SCAN outcomes
     (the gate's hit-rate evidence), not delivered verdicts."""
-    from .consistency import certify_encoded
+    from .certify_batch import certify_many
 
     results: list = [None] * len(encs)
     fam = type(model).__name__
@@ -195,18 +196,22 @@ def lin_fastpath_pass(encs: Sequence[EncodedHistory], model,
             continue
         t0 = time.perf_counter()
         hits = 0
-        for i in idxs:
-            e = encs[i]
-            ok, tier, _ = certify_encoded(
-                e, model,
-                max_steps=abort * max(e.n_events, 1) if abort else None)
+        # whole bucket through the batched certifier core (ISSUE 15;
+        # outcome-identical to the per-row scalar loop — the
+        # JGRAFT_CERTIFY_BATCH=0 arm — with the same per-row length-
+        # scaled abort budgets)
+        certs = certify_many(
+            [encs[i] for i in idxs], model,
+            max_steps=[abort * max(encs[i].n_events, 1) if abort
+                       else None for i in idxs])
+        for i, (ok, tier, _) in zip(idxs, certs):
             if ok:
                 hits += 1
                 results[i] = {
                     "valid?": VALID,
                     "algorithm": "greedy-witness",
-                    "op-count": e.n_ops,
-                    "concurrency-window": e.n_slots,
+                    "op-count": encs[i].n_ops,
+                    "concurrency-window": encs[i].n_slots,
                     # namespaced distinctly from the weak-rung
                     # certifier's greedy/backtrack so fleet tier
                     # attribution never conflates the two hit-rates
@@ -226,6 +231,7 @@ def lin_fastpath_pass(encs: Sequence[EncodedHistory], model,
         autotune.lin_fastpath_observe(sig, rows=len(idxs), hits=hits,
                                       wall_s=dt)
         _fp_bump(rows_scanned=len(idxs), rows_certified=hits,
+                 events_scanned=sum(encs[i].n_events for i in idxs),
                  certify_wall_s=dt)
     return results
 
@@ -1155,7 +1161,7 @@ def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
             autotune.lin_fastpath_observe(sig, rows=1, hits=int(ok),
                                           wall_s=dt)
             _fp_bump(rows_scanned=1, rows_certified=int(ok),
-                     certify_wall_s=dt)
+                     events_scanned=enc.n_events, certify_wall_s=dt)
             if ok:
                 note_tier(tier + "@lin", wall_s=dt)
                 return {"valid?": VALID, "algorithm": "greedy-witness",

@@ -55,6 +55,19 @@ EV_PAD = 0
 EV_OPEN = 1
 EV_FORCE = 2
 
+
+def encode_vector_on() -> bool:
+    """Whether encoding takes the vectorized columnar path (ISSUE 15
+    tentpole (a)): `encode_history` routes through the per-model
+    columnar twins + `_encode_history_columnar`, and the
+    `IncrementalEncoder` settles suffixes columnar-ly.
+    ``JGRAFT_ENCODE_VECTOR=0`` forces the per-pair Python loop — the
+    differential ORACLE arm (byte-identical output, pinned by
+    tests/test_fast_encode.py) and the A/B denominator
+    (scripts/ab_hostpath.py). Parsed defensively via `env_int`:
+    garbage warns and keeps the default (on)."""
+    return env_int("JGRAFT_ENCODE_VECTOR", 1, minimum=0) != 0
+
 #: Cap on opens carried by one macro-event row. Bounds the row width
 #: (3 + 4·P int32 lanes) independently of the concurrency window — a
 #: timeout-polluted sort-kernel history can hold ~100 slots open at
@@ -109,11 +122,14 @@ def encode_history(
     (`_encode_history_columnar`) — byte-identical output, ~7× less
     host time per op (the suite's end-to-end hist/s includes encode, so
     this is perf surface, not plumbing; round-4 work on VERDICT r3 #3).
+    ``JGRAFT_ENCODE_VECTOR=0`` (`encode_vector_on`) pins the per-pair
+    loop below instead — the differential oracle arm.
     """
 
     ops = list(history)
     pairs = pair_ops_indexed(ops)
-    cols = model.encode_pairs_columnar(pairs)
+    cols = (model.encode_pairs_columnar(pairs)
+            if encode_vector_on() else None)
     if cols is not None:
         return _encode_history_columnar(ops, model, cols, prune)
 
@@ -187,32 +203,39 @@ def _encode_history_columnar(ops, model, cols, prune: bool) -> EncodedHistory:
     the prune used to build (now four numpy columns)."""
     fs, as_, bs, forced, ips, cps = cols
     n = len(fs)
-    for k in range(n):
-        # Same contract as the per-pair path: forced ⇒ has a completion.
-        if forced[k] and cps[k] < 0:
-            raise ValueError(
-                f"model {type(model).__name__} encoded a pair with no "
-                f"completion as forced (invoke index {ops[ips[k]].index})")
-    if prune and not all(forced):
+    forced_a = np.asarray(forced, dtype=bool)
+    cps_a = np.asarray(cps, dtype=np.int64) if n else \
+        np.empty(0, dtype=np.int64)
+    # Same contract as the per-pair path: forced ⇒ has a completion
+    # (one vectorized check instead of a per-op loop).
+    bad = forced_a & (cps_a < 0)
+    if bad.any():
+        k = int(np.flatnonzero(bad)[0])
+        raise ValueError(
+            f"model {type(model).__name__} encoded a pair with no "
+            f"completion as forced (invoke index {ops[ips[k]].index})")
+    if prune and not forced_a.all():
         keep = _prune_dead_crashed_columnar(model, fs, as_, bs, forced,
                                             ips, cps)
         if keep is not None and not keep.all():
-            fs = [v for v, m in zip(fs, keep) if m]
-            as_ = [v for v, m in zip(as_, keep) if m]
-            bs = [v for v, m in zip(bs, keep) if m]
-            forced = [v for v, m in zip(forced, keep) if m]
-            ips = [v for v, m in zip(ips, keep) if m]
-            cps = [v for v, m in zip(cps, keep) if m]
+            fs = np.asarray(fs, dtype=np.int64)[keep]
+            as_ = np.asarray(as_, dtype=np.int64)[keep]
+            bs = np.asarray(bs, dtype=np.int64)[keep]
+            forced = forced_a[keep]
+            ips = np.asarray(ips, dtype=np.int64)[keep]
+            cps = cps_a[keep]
             n = len(fs)
 
     # Event stream = OPENs at invoke positions merged with FORCEs at the
     # completion positions of forced ops, ascending by history position
     # (positions are unique: one op per history row).
-    force_ks = [k for k in range(n) if forced[k]]
+    forced_a = np.asarray(forced, dtype=bool)
+    cps_a = np.asarray(cps, dtype=np.int64)
+    force_ks = np.flatnonzero(forced_a)
     n_ev = n + len(force_ks)
     ev_pos = np.empty(n_ev, dtype=np.int64)
     ev_pos[:n] = ips
-    ev_pos[n:] = [cps[k] for k in force_ks]
+    ev_pos[n:] = cps_a[force_ks]
     ev_k = np.empty(n_ev, dtype=np.int64)
     ev_k[:n] = np.arange(n)
     ev_k[n:] = force_ks
@@ -753,6 +776,15 @@ class IncrementalEncoder:
 
     def __init__(self, model):
         self.model = model
+        #: columnar settle (ISSUE 15 tentpole (a)): the settled-suffix
+        #: emit batch-encodes each settle's invokes through the model's
+        #: columnar twin instead of per-op `encode_pair` calls. Fixed at
+        #: construction (JGRAFT_ENCODE_VECTOR) and flipped off
+        #: permanently if the model has no columnar hook — the two
+        #: paths store different `_enc_of` payloads and must never mix
+        #: mid-session. Emitted streams are byte-identical either way
+        #: (tests/test_fast_encode.py pins random cuts).
+        self._vector = encode_vector_on()
         self.consumed = 0   # history rows ingested
         self.cut = 0        # rows settled (events emitted)
         self.n_ops = 0      # encoded (kept) ops
@@ -819,7 +851,109 @@ class IncrementalEncoder:
                 ipos = self._pending.pop(op.process)
                 self._comp[ipos] = op
                 self._inv_of[pos] = ipos
+        if self._vector:
+            return self._settle_vector(final)
         return self._settle(final)
+
+    def _settle_vector(self, final: bool):
+        """Columnar twin of `_settle` (ISSUE 15 tentpole (a)): the
+        settled prefix's invoke rows batch-encode through the model's
+        `encode_pairs_columnar` — one tight columnar pass instead of a
+        per-op `encode_pair` call with OpPair/EncodedOp construction —
+        then the slot/heap emission loop runs exactly like the scalar
+        path, so the emitted stream is byte-identical (differential-
+        pinned at random cuts). `_enc_of` stores the bare forced flag
+        here (True/False, None for dropped ops) — the only field the
+        completion branch reads — where the scalar path stores the
+        EncodedOp; the per-session `_vector` latch keeps the two
+        representations from ever mixing."""
+        advance = 0
+        for op in self._tail:
+            pos = self.cut + advance
+            if op.type == "invoke" and pos not in self._comp \
+                    and not final:
+                break  # completion not recorded yet: unsettled
+            advance += 1
+        empty = (np.empty((0, 5), dtype=np.int32),
+                 np.empty(0, dtype=np.int32),
+                 np.empty(0, dtype=np.int32))
+        if advance == 0:
+            return empty
+        pairs = []
+        # completion stream position per invoke position (the
+        # encode_pairs_columnar contract wants the COMPLETION's
+        # position in the pair tuple, like pair_ops_indexed emits —
+        # _inv_of maps completion pos -> invoke pos, so invert it;
+        # every recorded completion has an entry until the completion
+        # row itself settles, which is after this pass)
+        cpos_of = {ip: cp for cp, ip in self._inv_of.items()}
+        for j in range(advance):
+            op = self._tail[j]
+            if op.type == "invoke":
+                pos = self.cut + j
+                comp = self._comp.get(pos)
+                pairs.append((pos,
+                              -1 if comp is None else cpos_of[pos],
+                              op, comp))
+        cols = self.model.encode_pairs_columnar(pairs)
+        if cols is None:
+            # model without a columnar twin: latch the scalar path for
+            # the session's lifetime (nothing was stored vector-style
+            # yet — the scalar settle re-walks the untouched tail)
+            self._vector = False
+            return self._settle(final)
+        fs, as_, bs, forced, ips, _cps = cols
+        kept = {ip: (int(f), int(a), int(b), bool(fo))
+                for ip, f, a, b, fo in zip(ips, fs, as_, bs, forced)}
+
+        rows: list = []
+        op_idx: list = []
+        procs: list = []
+        for j in range(advance):
+            op = self._tail[j]
+            pos = self.cut + j
+            if op.type == "invoke":
+                ent = kept.get(pos)
+                self._enc_of[pos] = ent if ent is None else ent[3]
+                if ent is not None:
+                    f, a, b, fo = ent
+                    if fo and pos not in self._comp:
+                        raise ValueError(
+                            f"model {type(self.model).__name__} encoded "
+                            f"a pair with no completion as forced "
+                            f"(invoke index {op.index})")
+                    if self._free:
+                        slot = heapq.heappop(self._free)
+                    else:
+                        slot = self.n_slots
+                        self.n_slots += 1
+                    self._slot_of[pos] = slot
+                    rows.append((EV_OPEN, slot, f, a, b))
+                    op_idx.append(op.index if op.index >= 0 else pos)
+                    procs.append(self._pid_of.setdefault(
+                        op.process, len(self._pid_of)))
+                    self.n_ops += 1
+            else:
+                ipos = self._inv_of.pop(pos)
+                self._comp.pop(ipos, None)
+                encF = self._enc_of.pop(ipos, None)
+                if encF is True:
+                    slot = self._slot_of.pop(ipos)
+                    rows.append((EV_FORCE, slot, 0, 0, 0))
+                    op_idx.append(op.index if op.index >= 0 else pos)
+                    procs.append(self._pid_of.setdefault(
+                        op.process, len(self._pid_of)))
+                    heapq.heappush(self._free, slot)
+                elif encF is False:
+                    # optional (info) op: the slot never recycles
+                    self._slot_of.pop(ipos, None)
+        del self._tail[:advance]
+        self.cut += advance
+        self.n_events += len(rows)
+        events = np.asarray(rows, dtype=np.int32).reshape(-1, 5)
+        return (events,
+                np.asarray(op_idx, dtype=np.int32),
+                np.asarray(procs, dtype=np.int32))
 
     def _settle(self, final: bool):
         rows: list = []

@@ -83,6 +83,17 @@ class Model:
         """
         raise NotImplementedError
 
+    #: Columnar host twin of `step` (ISSUE 15): numpy int32 arrays over
+    #: a batch axis, -> (state' int32 array, legal bool array). The
+    #: batched certifier core (checker/certify_batch.py) evaluates one
+    #: op per row across a whole batch of histories with it, so it MUST
+    #: agree with the scalar `step` ELEMENTWISE — including int32
+    #: wraparound and packed-field masking — or batched verdicts drift
+    #: from the scalar engine (the differential tests pin this next to
+    #: the step↔jax_step pin). None (the default) routes every row
+    #: through the scalar certifier.
+    step_columnar = None
+
     def encode_pair(self, pair: OpPair) -> Optional[EncodedOp]:
         """Encode one invocation/completion pair, or None to drop it."""
         if pair.ctype == FAIL:

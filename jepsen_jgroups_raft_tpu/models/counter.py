@@ -58,6 +58,18 @@ class Counter(Model):
         new_state = jnp.where(f == READ, state, added)
         return new_state, legal
 
+    def step_columnar(self, state, f, a, b):
+        """Numpy batch twin of `step` (models/base.py contract): int32
+        array addition wraps exactly like `_wrap32`."""
+        import numpy as np
+
+        added = (state + a).astype(np.int32)
+        legal = (f == ADD) | ((f == READ) & (state == a)) | (
+            (f == ADD_AND_GET) & (added == b)
+        )
+        new_state = np.where(f == READ, state, added).astype(np.int32)
+        return new_state, legal
+
     # State after a set of linearized ops = initial + Σ deltas, regardless
     # of order — the property the mask-mode dense kernel exploits
     # (ops/dense_scan.py): the frontier needs no state dimension.

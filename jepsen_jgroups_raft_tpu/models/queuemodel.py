@@ -101,6 +101,31 @@ class TicketQueue(Model):
             + jnp.where(enq, 1 << TICKET_BITS, 0)
         return new_state, legal
 
+    def step_columnar(self, state, f, a, b):
+        """Numpy batch twin of `step` (models/base.py contract) —
+        mirrors the SCALAR `step` exactly, including `pack_state`'s
+        per-field masking at the 2^15 boundary (where `jax_step`'s
+        additive form would carry across fields; the encoder rejects
+        histories long enough to reach it, so the two only differ
+        outside the encodable domain)."""
+        import numpy as np
+
+        h = state & TICKET_MAX
+        t = (state >> TICKET_BITS) & TICKET_MAX
+        enq = (f == ENQ) | (f == ENQ_ANY)
+        deq = (f == DEQ) | (f == DEQ_ANY)
+        nonempty = h < t
+        legal = ((f == ENQ_ANY)
+                 | ((f == ENQ) & (a == t))
+                 | ((f == DEQ_ANY) & nonempty)
+                 | ((f == DEQ) & nonempty & (a == h))
+                 | ((f == DEQ_EMPTY) & (h == t)))
+        nh = np.where(deq, (h + 1) & TICKET_MAX, h)
+        nt = np.where(enq, (t + 1) & TICKET_MAX, t)
+        new_state = np.where(enq | deq, nh | (nt << TICKET_BITS),
+                             state).astype(np.int32)
+        return new_state, legal
+
     def mask_delta(self, f, a, b):
         enq = (f == ENQ) | (f == ENQ_ANY)
         deq = (f == DEQ) | (f == DEQ_ANY)

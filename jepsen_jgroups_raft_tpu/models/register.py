@@ -61,6 +61,19 @@ class CasRegister(Model):
         )
         return new_state, legal
 
+    def step_columnar(self, state, f, a, b):
+        """Numpy batch twin of `step` (models/base.py contract): same
+        select logic as `jax_step`, host-side."""
+        import numpy as np
+
+        is_write = f == WRITE
+        is_cas = f == CAS
+        match = state == a
+        legal = is_write | match
+        new_state = np.where(is_write, a,
+                             np.where(is_cas & match, b, state))
+        return new_state.astype(np.int32), legal
+
     def dense_domain(self, events):
         """Reachable register values: initial ∪ {a of writes} ∪ {b of cas}
         (a write sets a; a successful cas sets b; reads keep state). Read

@@ -86,6 +86,16 @@ class GSet(Model):
         new_state = jnp.where(is_add, state | a, state)
         return new_state, legal
 
+    def step_columnar(self, state, f, a, b):
+        """Numpy batch twin of `step` (models/base.py contract): int32
+        bitwise OR matches `_or32` bit for bit."""
+        import numpy as np
+
+        is_add = f == ADD
+        legal = is_add | (state == a)
+        new_state = np.where(is_add, state | a, state).astype(np.int32)
+        return new_state, legal
+
     def mask_delta(self, f, a, b):
         # Valid ONLY under mask_eligible's distinct-bit proof: each
         # add's single-bit delta sums without carries, so Σ == OR.

@@ -147,11 +147,20 @@ class ServiceClient:
         if len(self.netlocs) == 1:
             return list(self.netlocs)
         if affinity:
-            ordered = sorted(
-                self.netlocs,
-                key=lambda n: hashlib.sha256(
-                    f"{affinity}|{n}".encode()).hexdigest(),
-                reverse=True)
+            # ONE digest construction per route (ISSUE 15 satellite):
+            # the affinity prefix is hashed once and each replica's
+            # rendezvous key extends a cheap .copy() of that state —
+            # byte-identical to sha256(f"{affinity}|{n}") (same input
+            # stream), so the route order is unchanged, but the
+            # per-replica rehash of the (payload-sized) key is gone.
+            hd = hashlib.sha256(affinity.encode())
+
+            def rendezvous(n: str) -> str:
+                h = hd.copy()
+                h.update(f"|{n}".encode())
+                return h.hexdigest()
+
+            ordered = sorted(self.netlocs, key=rendezvous, reverse=True)
         else:
             ordered = list(self.netlocs)
         now = time.monotonic()

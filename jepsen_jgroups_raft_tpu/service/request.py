@@ -86,7 +86,15 @@ def fingerprint_encodings(model, algorithm: str,
     FORCEs along per-process order, so two submissions with identical
     event rows but different proc arrays genuinely have different
     verdicts there (at the linearizable rung proc is inert and stays
-    out of the hash, preserving wire-noise insensitivity)."""
+    out of the hash, preserving wire-noise insensitivity).
+
+    Zero-copy (ISSUE 15 tentpole (d)): the packed int32 buffers feed
+    sha256 through memoryviews — `hashlib.update` consumes any
+    C-contiguous buffer directly, so the per-submission `tobytes()`
+    copies of the (often multi-MB) event tensors are gone. The BYTES
+    hashed are identical, so every digest value is unchanged — the
+    content-addressed store and the WAL replay key on these values
+    (pinned by the golden-fingerprint test)."""
     h = hashlib.sha256()
     h.update(type(model).__name__.encode())
     h.update(b"\x00")
@@ -96,14 +104,14 @@ def fingerprint_encodings(model, algorithm: str,
         h.update(b"\x00")
         h.update(consistency.encode())
     for e in encs:
-        h.update(np.asarray(e.events.shape, dtype=np.int64).tobytes())
-        h.update(np.ascontiguousarray(e.events).tobytes())
-        h.update(np.int64(e.n_slots).tobytes())
+        h.update(memoryview(np.asarray(e.events.shape, dtype=np.int64)))
+        h.update(memoryview(np.ascontiguousarray(e.events)))
+        h.update(np.int64(e.n_slots).data)
         if weak:
             h.update(b"\x01" if e.proc is not None else b"\x00")
             if e.proc is not None:
-                h.update(np.ascontiguousarray(
-                    np.asarray(e.proc, dtype=np.int32)).tobytes())
+                h.update(memoryview(np.ascontiguousarray(
+                    np.asarray(e.proc, dtype=np.int32))))
     return h.hexdigest()
 
 

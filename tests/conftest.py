@@ -36,3 +36,14 @@ os.environ.setdefault("JGRAFT_AUTOTUNE", "0")
 # opt back in with monkeypatched env. JGRAFT_LIN_FASTPATH=0 is the
 # documented force-disable/A-B arm; production default stays ON.
 os.environ.setdefault("JGRAFT_LIN_FASTPATH", "0")
+
+# The ISSUE-15 host-path knobs (JGRAFT_ENCODE_VECTOR,
+# JGRAFT_CERTIFY_BATCH, JGRAFT_JOURNAL_GROUP_MS) stay at their
+# production defaults (ON) here, per the house rule: a knob is pinned
+# off in kernel-path suites only when it changes ROUTING those suites
+# assert on. These change neither routing nor verdicts — encode output
+# is byte-identical, the batch certifier picks an ENGINE inside the
+# host certify pass (which JGRAFT_LIN_FASTPATH=0 above already keeps
+# out of kernel suites), and group commit only coalesces fsyncs.
+# Their differential tests (tests/test_hostpath_turbo.py) pin both
+# arms explicitly.

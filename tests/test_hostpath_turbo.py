@@ -1,0 +1,456 @@
+"""ISSUE 15 host-path turbo: differential + invariant pins.
+
+Four legs, each pinned against the engine it replaced:
+
+* vectorized columnar encode (``JGRAFT_ENCODE_VECTOR``) — byte-identical
+  packed tensors vs the per-pair Python oracle, across all 4 model
+  families x macro on/off x random/corrupt histories, at the one-shot
+  AND the IncrementalEncoder (random-cut settle) surfaces;
+* the batched NumPy certifier core (checker/certify_batch.py) — per-row
+  (certified, tier, flips) triples identical to `certify_encoded`,
+  including the backtrack-handoff boundary, abort-budget identity, and
+  the measured per-bucket gate (routing only, never verdicts);
+* WAL group commit (``JGRAFT_JOURNAL_GROUP_MS``) — concurrent appends
+  coalesce into one fsync, the §11 durability point holds (every append
+  that returned True survives replay, through a torn tail), and a
+  failed group fsync degrades every member loudly;
+* zero-copy fingerprints — golden digests pinned (the content-addressed
+  store and the WAL replay key on the VALUES, so they may never move).
+"""
+
+import hashlib
+import os
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker import certify_batch as cb
+from jepsen_jgroups_raft_tpu.checker.consistency import certify_encoded
+from jepsen_jgroups_raft_tpu.history.packing import (EncodedHistory,
+                                                     IncrementalEncoder,
+                                                     encode_history)
+from jepsen_jgroups_raft_tpu.history.synth import (corrupt,
+                                                   random_valid_history)
+from jepsen_jgroups_raft_tpu.models import (CasRegister, Counter, GSet,
+                                            TicketQueue)
+from jepsen_jgroups_raft_tpu.service import journal as journal_mod
+from jepsen_jgroups_raft_tpu.service.journal import AdmissionJournal
+from jepsen_jgroups_raft_tpu.service.request import (admit,
+                                                     fingerprint_encodings)
+
+MODELS = {"register": CasRegister, "counter": Counter, "set": GSet,
+          "queue": TicketQueue}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_gate():
+    cb.reset_gate()
+    yield
+    cb.reset_gate()
+
+
+# ------------------------------------------------- vectorized encode
+
+
+class TestEncodeVector:
+    @pytest.mark.parametrize("kind", sorted(MODELS))
+    @pytest.mark.parametrize("macro", ["1", "0"])
+    def test_vector_oracle_differential(self, kind, macro, monkeypatch):
+        """JGRAFT_ENCODE_VECTOR=0 (the per-pair oracle) and the default
+        vectorized path emit byte-identical packed tensors — random +
+        synth-corrupt histories, both prune modes, macro on/off."""
+        monkeypatch.setenv("JGRAFT_MACRO_EVENTS", macro)
+        model_cls = MODELS[kind]
+        rng = random.Random(1500 + len(kind))
+        for trial in range(60):
+            m = model_cls()
+            h = random_valid_history(rng, kind,
+                                     n_ops=rng.randint(1, 120),
+                                     n_procs=rng.randint(1, 6),
+                                     crash_p=rng.uniform(0, 0.3),
+                                     max_crashes=rng.randint(0, 4))
+            if trial % 3 == 0:
+                h = corrupt(rng, h)
+            for prune in (True, False):
+                monkeypatch.delenv("JGRAFT_ENCODE_VECTOR",
+                                   raising=False)
+                a = encode_history(h, m, prune=prune)
+                monkeypatch.setenv("JGRAFT_ENCODE_VECTOR", "0")
+                b = encode_history(h, m, prune=prune)
+                monkeypatch.delenv("JGRAFT_ENCODE_VECTOR")
+                assert np.array_equal(a.events, b.events), (kind, prune)
+                assert np.array_equal(a.op_index, b.op_index)
+                assert np.array_equal(a.proc, b.proc)
+                assert a.n_slots == b.n_slots and a.n_ops == b.n_ops
+
+    @pytest.mark.parametrize("kind", sorted(MODELS))
+    def test_incremental_settle_differential(self, kind, monkeypatch):
+        """The columnar settled-suffix emit (`_settle_vector`) is
+        byte-identical to the scalar settle at RANDOM cuts — streams,
+        op_index, proc, slot accounting."""
+        rng = random.Random(4000 + len(kind))
+        for trial in range(12):
+            m = MODELS[kind]()
+            h = random_valid_history(
+                random.Random(rng.randrange(1 << 30)), kind,
+                n_ops=rng.randrange(1, 60), n_procs=rng.randrange(1, 5),
+                crash_p=rng.choice([0.0, 0.25]))
+            ops = list(h.client_ops())
+            cuts = sorted(rng.randrange(len(ops) + 1)
+                          for _ in range(3)) if ops else []
+            streams = {}
+            for arm in ("1", "0"):
+                monkeypatch.setenv("JGRAFT_ENCODE_VECTOR", arm)
+                enc = IncrementalEncoder(m)
+                parts, i = [], 0
+                for c in cuts + [len(ops)]:
+                    parts.append(enc.feed(ops[i:c]))
+                    i = c
+                parts.append(enc.feed([], final=True))
+                streams[arm] = (
+                    np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]),
+                    np.concatenate([p[2] for p in parts]),
+                    enc.n_slots, enc.n_ops)
+            monkeypatch.delenv("JGRAFT_ENCODE_VECTOR")
+            for a, b in zip(streams["1"], streams["0"]):
+                if isinstance(a, np.ndarray):
+                    assert np.array_equal(a, b), kind
+                else:
+                    assert a == b, kind
+
+    def test_incremental_latches_scalar_without_columnar_hook(
+            self, monkeypatch):
+        """A model whose columnar twin answers None latches the scalar
+        settle for the session (the two `_enc_of` payloads must never
+        mix) — output still identical."""
+        m = CasRegister()
+        h = random_valid_history(random.Random(5), "register", n_ops=24)
+        ops = list(h.client_ops())
+        ref = encode_history(ops, m, prune=False)
+        monkeypatch.setattr(CasRegister, "encode_pairs_columnar",
+                            lambda self, pairs: None)
+        enc = IncrementalEncoder(m)
+        assert enc._vector is True
+        parts = [enc.feed(ops[:7]), enc.feed(ops[7:]),
+                 enc.feed([], final=True)]
+        assert enc._vector is False  # latched on the first settle
+        ev = np.concatenate([p[0] for p in parts])
+        assert np.array_equal(ev, ref.events)
+        assert enc.n_ops == ref.n_ops and enc.n_slots == ref.n_slots
+
+    def test_encode_vector_knob_garbage_never_crashes(self, monkeypatch):
+        monkeypatch.setenv("JGRAFT_ENCODE_VECTOR", "banana")
+        m = CasRegister()
+        h = random_valid_history(random.Random(2), "register", n_ops=10)
+        enc = encode_history(h, m)  # defaults on, importer survives
+        assert enc.n_ops > 0
+
+
+# ---------------------------------------------- batched certifier core
+
+
+def _scalar_triples(encs, model, ms_list=None):
+    out = []
+    for i, e in enumerate(encs):
+        ms = None if ms_list is None else ms_list[i]
+        out.append(certify_encoded(e, model, max_steps=ms))
+    return out
+
+
+class TestCertifyBatch:
+    @pytest.mark.parametrize("kind", sorted(MODELS))
+    def test_verdict_tier_identity(self, kind, monkeypatch):
+        """Batched triples == scalar triples — valid AND corrupt rows,
+        including the backtrack-handoff boundary (rows the scalar
+        engine decides via restores must come back with the scalar's
+        exact tier)."""
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN", "1")
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN_OBS", "100000")
+        m = MODELS[kind]()
+        rng = random.Random(77)
+        hs = [random_valid_history(rng, kind, n_ops=rng.randint(4, 120),
+                                   n_procs=rng.randint(1, 6),
+                                   crash_p=rng.uniform(0, 0.25),
+                                   max_crashes=3) for _ in range(40)]
+        hs = [corrupt(rng, h) if i % 4 == 0 else h
+              for i, h in enumerate(hs)]
+        encs = [encode_history(h, m) for h in hs]
+        got = cb.certify_many(encs, m)
+        assert got == _scalar_triples(encs, m), kind
+        if kind == "register":
+            # the boundary family: restores must actually have occurred
+            # for the handoff leg to be exercised
+            assert any(t == "backtrack" for _, t, _ in got)
+
+    def test_abort_budget_identity(self, monkeypatch):
+        """Per-row max_steps: the batch scan's mirrored step accounting
+        aborts exactly where the scalar wrapper does."""
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN", "1")
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN_OBS", "100000")
+        for kind in ("queue", "set", "register"):
+            m = MODELS[kind]()
+            rng = random.Random(31)
+            encs = [encode_history(
+                random_valid_history(rng, kind, n_ops=40, n_procs=4,
+                                     crash_p=0.1, max_crashes=2), m)
+                for _ in range(24)]
+            for abort in (1, 2, 4, 1000):
+                ms = [abort * max(e.n_events, 1) for e in encs]
+                assert cb.certify_many(encs, m, max_steps=ms) == \
+                    _scalar_triples(encs, m, ms), (kind, abort)
+
+    def test_measured_gate_routes_scalar_never_verdicts(
+            self, monkeypatch):
+        """A bucket observed below the hit-rate floor stops engaging
+        the batch pass (routing); outcomes stay identical before and
+        after the latch."""
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN", "1")
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN_OBS", "8")
+        m = CasRegister()
+        rng = random.Random(9)
+        # register at multi-proc shapes is backtrack-dominated: the
+        # scan falls back, so observed hits stay ~0 and the gate latches
+        encs = [encode_history(
+            random_valid_history(rng, "register", n_ops=60, n_procs=5,
+                                 crash_p=0.2, max_crashes=3), m)
+            for _ in range(16)]
+        ref = _scalar_triples(encs, m)
+        assert cb.certify_many(encs, m) == ref     # observes >= 8 rows
+        sig = cb._gate_sig(m, encs[0])
+        rows, hits = cb._GATE[sig]
+        assert rows >= 8
+        if hits / rows < cb.certify_batch_min_hit():
+            assert not cb._gate_allows(sig)
+        assert cb.certify_many(encs, m) == ref     # post-latch identity
+
+    def test_engagement_floor_routes_scalar(self, monkeypatch):
+        """Below JGRAFT_CERTIFY_BATCH_MIN nothing engages (no gate
+        observations) and outcomes are the scalar engine's."""
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH_MIN", "64")
+        m = GSet()
+        rng = random.Random(3)
+        encs = [encode_history(
+            random_valid_history(rng, "set", n_ops=30), m)
+            for _ in range(8)]
+        assert cb.certify_many(encs, m) == _scalar_triples(encs, m)
+        assert not cb._GATE
+
+    def test_batch_off_arm_and_garbage_knob(self, monkeypatch):
+        m = TicketQueue()
+        rng = random.Random(4)
+        encs = [encode_history(
+            random_valid_history(rng, "queue", n_ops=30), m)
+            for _ in range(6)]
+        ref = _scalar_triples(encs, m)
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH", "0")
+        assert cb.certify_many(encs, m) == ref
+        monkeypatch.setenv("JGRAFT_CERTIFY_BATCH", "garbage")
+        assert cb.certify_many(encs, m) == ref  # default on, no crash
+
+
+# ------------------------------------------------- WAL group commit
+
+
+def _req(seed=1, n=1):
+    return admit([random_valid_history(random.Random(seed + i),
+                                       "register", n_ops=8, crash_p=0.0)
+                  for i in range(n)], "register")
+
+
+class TestGroupCommit:
+    def test_concurrent_appends_coalesce_and_survive(self, tmp_path,
+                                                     monkeypatch):
+        """8 concurrent appenders under a slow fsync: every append
+        returns True, the WAL issues FEWER fsyncs than appends
+        (coalescing evidence), occupancy > 1, and replay sees every
+        record — the §11 point, per member."""
+        monkeypatch.setenv("JGRAFT_JOURNAL_GROUP_MS", "20")
+        real_fsync = os.fsync
+
+        def slow_fsync(fd):
+            real_fsync(fd)
+            import time as _t
+            _t.sleep(0.01)   # widen the window followers pile into
+        monkeypatch.setattr(journal_mod.os, "fsync", slow_fsync)
+        j = AdmissionJournal(tmp_path)
+        reqs = [_req(seed=100 + i) for i in range(16)]
+        oks = [None] * 16
+        barrier = threading.Barrier(8)
+
+        def worker(k):
+            barrier.wait()
+            for i in range(k, 16, 8):
+                oks[i] = j.append_submit(reqs[i])
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(8)]
+        [t.start() for t in ts]
+        [t.join(30) for t in ts]
+        assert all(oks)
+        st = j.stats()
+        assert st["journal_appends"] == 16
+        assert st["journal_group_ms"] == 20
+        assert 1 <= st["journal_group_commits"] < 16
+        assert st["journal_group_occupancy_mean"] > 1.0
+        j.close()
+        out = AdmissionJournal(tmp_path).replay()
+        assert out["skipped"] == 0
+        assert {r.id for r in out["unfinished"]} == \
+            {r.id for r in reqs}
+
+    def test_torn_tail_after_group_keeps_fsynced_records(self, tmp_path,
+                                                         monkeypatch,
+                                                         caplog):
+        """SIGKILL between a coalesced write and its fsync leaves a
+        torn tail — replay skips it loudly and every record whose
+        append RETURNED (i.e. was fsync-covered) survives."""
+        monkeypatch.setenv("JGRAFT_JOURNAL_GROUP_MS", "5")
+        j = AdmissionJournal(tmp_path)
+        reqs = [_req(seed=200 + i) for i in range(3)]
+        assert all(j.append_submit(r) for r in reqs)
+        j.close()
+        with open(j.path, "ab") as f:   # the un-fsynced victim's torn half
+            f.write(b'{"kind":"submit","id":"torn","v":1,"uni')
+        out = AdmissionJournal(tmp_path).replay()
+        assert out["skipped"] == 1
+        assert {r.id for r in out["unfinished"]} == {r.id for r in reqs}
+
+    def test_group_fsync_failure_degrades_every_member(self, tmp_path,
+                                                       monkeypatch):
+        """A failed group write counts an error PER RECORD and returns
+        False to every member — durability degraded, availability
+        kept, exactly the per-append contract."""
+        monkeypatch.setenv("JGRAFT_JOURNAL_GROUP_MS", "10")
+        real_fsync = os.fsync
+
+        def boom(fd):
+            raise OSError("disk says no")
+        monkeypatch.setattr(journal_mod.os, "fsync", boom)
+        j = AdmissionJournal(tmp_path)
+        assert j.append_submit(_req(seed=300)) is False
+        assert j.stats()["journal_errors"] == 1
+        monkeypatch.setattr(journal_mod.os, "fsync", real_fsync)
+        assert j.append_submit(_req(seed=301)) is True
+        j.close()
+
+    def test_group_ms_zero_restores_per_append(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("JGRAFT_JOURNAL_GROUP_MS", "0")
+        j = AdmissionJournal(tmp_path)
+        assert j.append_submit(_req(seed=400))
+        st = j.stats()
+        assert st["journal_group_ms"] == 0
+        assert st["journal_group_commits"] == 0
+        assert st["journal_appends"] == 1
+        j.close()
+
+    def test_group_ms_garbage_never_crashes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JGRAFT_JOURNAL_GROUP_MS", "lots")
+        j = AdmissionJournal(tmp_path)
+        assert j.append_submit(_req(seed=500))  # default window, no crash
+        j.close()
+
+
+# ----------------------------------------------- zero-copy fingerprints
+
+
+def _golden_encs():
+    ev = np.array([[1, 0, 1, 7, 0], [2, 0, 0, 0, 0],
+                   [1, 1, 0, 7, 0], [2, 1, 0, 0, 0]], dtype=np.int32)
+    e1 = EncodedHistory(events=ev,
+                        op_index=np.array([0, 0, 1, 1], dtype=np.int32),
+                        n_slots=2, n_ops=2,
+                        proc=np.array([0, 0, 1, 1], dtype=np.int32))
+    e2 = EncodedHistory(events=ev[:2],
+                        op_index=np.array([0, 0], dtype=np.int32),
+                        n_slots=1, n_ops=1, proc=None)
+    return e1, e2
+
+
+class TestFingerprints:
+    def test_golden_digests_pinned(self):
+        """The content-addressed store and the WAL key on these VALUES:
+        any refactor that moves them corrupts both. Hard-coded, not
+        derived — that is the point."""
+        e1, e2 = _golden_encs()
+        assert fingerprint_encodings(CasRegister(), "auto", [e1, e2]) == \
+            ("c22c34fa6429e10a20aa7cdb7c27d350"
+             "bfa86ad507e3ac9ebf4c0f26f215f352")
+        assert fingerprint_encodings(CasRegister(), "auto", [e1, e2],
+                                     "sequential") == \
+            ("3e27fd9d44f8c22e52a853a2eb5e197b"
+             "59a27275e91e5be22ff1ce54bd9ed981")
+        assert fingerprint_encodings(TicketQueue(), "jax", [e1]) == \
+            ("0b73e46e4f27639af75c4b4582771f49"
+             "fd6825624ae70c32d392c8a03ab4b025")
+
+    def test_memoryview_equals_tobytes_reference(self):
+        """The zero-copy feed hashes the SAME byte stream as the
+        `tobytes()` reference — including non-contiguous inputs (the
+        ascontiguousarray hop) and proc-carrying weak-rung hashes."""
+        rng = random.Random(15)
+        m = CasRegister()
+        encs = [encode_history(
+            random_valid_history(rng, "register", n_ops=30,
+                                 crash_p=0.1), m) for _ in range(8)]
+        # a deliberately non-contiguous events view
+        wide = np.ascontiguousarray(
+            np.repeat(encs[0].events, 2, axis=0))[::2]
+        assert not wide.flags["C_CONTIGUOUS"]
+        encs.append(EncodedHistory(events=wide,
+                                   op_index=encs[0].op_index,
+                                   n_slots=encs[0].n_slots,
+                                   n_ops=encs[0].n_ops,
+                                   proc=encs[0].proc))
+        for consistency in ("linearizable", "sequential", "session"):
+            h = hashlib.sha256()
+            h.update(b"CasRegister\x00auto")
+            weak = consistency != "linearizable"
+            if weak:
+                h.update(b"\x00" + consistency.encode())
+            for e in encs:
+                h.update(np.asarray(e.events.shape,
+                                    dtype=np.int64).tobytes())
+                h.update(np.ascontiguousarray(e.events).tobytes())
+                h.update(np.int64(e.n_slots).tobytes())
+                if weak:
+                    h.update(b"\x01" if e.proc is not None else b"\x00")
+                    if e.proc is not None:
+                        h.update(np.ascontiguousarray(
+                            np.asarray(e.proc,
+                                       dtype=np.int32)).tobytes())
+            assert fingerprint_encodings(m, "auto", encs, consistency) \
+                == h.hexdigest()
+
+
+# ------------------------------------------- client routing digest reuse
+
+
+class TestRouteDigestReuse:
+    def test_one_digest_construction_per_route(self, monkeypatch):
+        """The rendezvous loop reuses ONE sha256 of the (payload-sized)
+        affinity key via .copy() — and the route order is byte-
+        identical to the per-replica rehash it replaced."""
+        from jepsen_jgroups_raft_tpu.service import client as client_mod
+
+        cl = client_mod.ServiceClient(
+            "http://a:1", replicas=["http://b:2", "http://c:3",
+                                    "http://d:4"])
+        affinity = "x" * 4096
+        expected = sorted(
+            cl.netlocs,
+            key=lambda n: hashlib.sha256(
+                f"{affinity}|{n}".encode()).hexdigest(),
+            reverse=True)
+        calls = []
+        real = hashlib.sha256
+
+        def counting(*a, **kw):
+            calls.append(a)
+            return real(*a, **kw)
+        monkeypatch.setattr(client_mod.hashlib, "sha256", counting)
+        route = cl._route(affinity=affinity)
+        assert len(calls) == 1, "route must hash the affinity key once"
+        assert route == expected
