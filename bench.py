@@ -39,13 +39,18 @@ import sys
 import time
 import traceback
 
+# Safe before the TPU probe: platform.py is jax-free at import (jax is
+# imported lazily inside pin_cpu), so pulling the knob parsers in here
+# does not trigger backend init in the parent process.
+from jepsen_jgroups_raft_tpu.platform import env_float, env_int, pin_cpu
+
 PROBE_TIMEOUT_S = 120.0  # first TPU init can be slow; hang is the failure mode
 # A flaky (not just dead) tunnel: retry the probe in fresh subprocesses over
 # a bounded window before settling for the CPU fallback. Round 3 proved the
 # tunnel can be up and down within one day; a single probe converts "flaky"
 # into "no TPU number this round" (three rounds running — VERDICT r3 #1).
-RETRY_SLEEP_S = float(os.environ.get("JGRAFT_BENCH_PROBE_RETRY_S", "60"))
-RETRY_WINDOW_S = float(os.environ.get("JGRAFT_BENCH_PROBE_WINDOW_S", "600"))
+RETRY_SLEEP_S = env_float("JGRAFT_BENCH_PROBE_RETRY_S", 60.0, minimum=0.0)
+RETRY_WINDOW_S = env_float("JGRAFT_BENCH_PROBE_WINDOW_S", 600.0, minimum=0.0)
 
 
 #: Probe failure diagnostics for the CURRENT process, stamped into every
@@ -117,9 +122,6 @@ def probe_with_retry(keep_env_pin: bool) -> tuple[str | None, int]:
         if platform is not None or time.monotonic() >= deadline:
             return platform, attempts
         time.sleep(min(RETRY_SLEEP_S, max(0.0, deadline - time.monotonic())))
-
-
-from jepsen_jgroups_raft_tpu.platform import env_int, pin_cpu  # noqa: E402
 
 
 def bench_pin_cpu() -> None:
@@ -290,7 +292,7 @@ def cold_warm(rep_times: list) -> dict:
 # inter-beat span (CPU suite config-1 rep ≈ 67 s, cold XLA compile
 # ≈ 40 s, config-3 cluster recording beats per phase).
 
-WATCHDOG_GAP_S = float(os.environ.get("JGRAFT_BENCH_WATCHDOG_S", "300"))
+WATCHDOG_GAP_S = env_float("JGRAFT_BENCH_WATCHDOG_S", 300.0, minimum=0.0)
 _last_beat = time.monotonic()
 
 #: Best-effort teardown hooks for resources that would otherwise outlive
@@ -364,7 +366,7 @@ def best_of(fn, profile_dir: str | None = None):
     mood, not the machine, so every bench row reports its best rep with
     the full spread preserved in the artifact. `profile_dir` wraps the
     FIRST rep in a profiler trace (JGRAFT_PROFILE_DIR plumbing)."""
-    n = max(1, int(os.environ.get("JGRAFT_BENCH_REPS", "3")))
+    n = env_int("JGRAFT_BENCH_REPS", 3, minimum=1)
     results = []
     for i in range(n):
         if i == 0 and profile_dir:
@@ -847,7 +849,7 @@ def run_suite(platform_note: str) -> None:
           "host_fingerprint": host_fingerprint()})
     # JGRAFT_SUITE_SCALE in (0,1] shrinks every config proportionally —
     # smoke-testing the suite plumbing without the full-size wall clock.
-    scale = float(os.environ.get("JGRAFT_SUITE_SCALE", "1"))
+    scale = env_float("JGRAFT_SUITE_SCALE", 1.0, minimum=0.0)
 
     def sz(n, floor=1):
         return max(floor, int(n * scale))
@@ -1046,10 +1048,10 @@ def run_service(platform_note: str) -> None:
             run_service_cluster(platform_note, n_replicas)
             return
 
-    n_requests = int(os.environ.get("JGRAFT_SERVICE_BENCH_REQUESTS", "64"))
-    n_hists = int(os.environ.get("JGRAFT_SERVICE_BENCH_HISTORIES", "4"))
-    n_ops = int(os.environ.get("JGRAFT_SERVICE_BENCH_OPS", "200"))
-    n_clients = int(os.environ.get("JGRAFT_SERVICE_BENCH_CLIENTS", "8"))
+    n_requests = env_int("JGRAFT_SERVICE_BENCH_REQUESTS", 64, minimum=1)
+    n_hists = env_int("JGRAFT_SERVICE_BENCH_HISTORIES", 4, minimum=1)
+    n_ops = env_int("JGRAFT_SERVICE_BENCH_OPS", 200, minimum=1)
+    n_clients = env_int("JGRAFT_SERVICE_BENCH_CLIENTS", 8, minimum=1)
 
     rng = _random.Random(20260803)
     # Per-request distinct histories: identical payloads would measure
@@ -1359,9 +1361,9 @@ def run_service_stream(platform_note: str) -> None:
                                                  journal_enabled,
                                                  serve_in_thread)
 
-    n_sessions = int(os.environ.get("JGRAFT_STREAM_BENCH_SESSIONS", "8"))
-    n_segments = int(os.environ.get("JGRAFT_STREAM_BENCH_SEGMENTS", "16"))
-    n_ops = int(os.environ.get("JGRAFT_STREAM_BENCH_OPS", "64"))
+    n_sessions = env_int("JGRAFT_STREAM_BENCH_SESSIONS", 8, minimum=1)
+    n_segments = env_int("JGRAFT_STREAM_BENCH_SEGMENTS", 16, minimum=1)
+    n_ops = env_int("JGRAFT_STREAM_BENCH_OPS", 64, minimum=1)
 
     rng = _random.Random(20260804)
     # Per-session op streams, pre-chopped into segments (synthesis off
@@ -1554,10 +1556,10 @@ def run_service_cluster(platform_note: str, n_replicas: int) -> None:
                                                  ServiceError,
                                                  serve_in_thread)
 
-    n_requests = int(os.environ.get("JGRAFT_SERVICE_BENCH_REQUESTS", "64"))
-    n_hists = int(os.environ.get("JGRAFT_SERVICE_BENCH_HISTORIES", "4"))
-    n_ops = int(os.environ.get("JGRAFT_SERVICE_BENCH_OPS", "200"))
-    n_clients = int(os.environ.get("JGRAFT_SERVICE_BENCH_CLIENTS", "8"))
+    n_requests = env_int("JGRAFT_SERVICE_BENCH_REQUESTS", 64, minimum=1)
+    n_hists = env_int("JGRAFT_SERVICE_BENCH_HISTORIES", 4, minimum=1)
+    n_ops = env_int("JGRAFT_SERVICE_BENCH_OPS", 200, minimum=1)
+    n_clients = env_int("JGRAFT_SERVICE_BENCH_CLIENTS", 8, minimum=1)
 
     rng = _random.Random(20260804)
     cluster_tmp = tempfile.mkdtemp(prefix="graftd-bench-cluster-")

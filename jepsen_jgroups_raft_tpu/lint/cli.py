@@ -34,7 +34,8 @@ from typing import List, Optional
 
 from . import jit_hygiene, lock_discipline, report, taxonomy
 from .base import Finding, collect_files, rel
-from .flow import heal, kernel_contract, resource
+from .flow import crashproto, envknobs, guarded, heal, kernel_contract, \
+    lockorder, resource
 from .flow.kernel_contract import DEFAULT_VMEM_BUDGET
 
 #: name → (module, suffixes)
@@ -45,6 +46,11 @@ ANALYZERS = {
     "kernel": (kernel_contract, (".py",)),
     "heal": (heal, (".py",)),
     "resource": (resource, (".py",)),
+    # graftsync tier (ISSUE 16): concurrency + crash-consistency
+    "guarded": (guarded, (".py",)),
+    "lockorder": (lockorder, (".py",)),
+    "crashproto": (crashproto, (".py",)),
+    "envknobs": (envknobs, (".py",)),
 }
 
 RULES = {
@@ -58,9 +64,30 @@ RULES = {
                "kernel-unresolved"),
     "heal": ("flow-unhealed-fault",),
     "resource": ("flow-resource-leak",),
+    "guarded": ("flow-unguarded-access",),
+    "lockorder": ("flow-lock-cycle", "flow-lock-order",
+                  "flow-lock-unranked"),
+    "crashproto": ("flow-fsync-before-ack", "flow-inplace-publish",
+                   "flow-nonatomic-publish"),
+    "envknobs": ("flow-env-raw-parse", "flow-env-undocumented",
+                 "flow-env-dup-default"),
 }
 
-DEFAULT_RULES = "taxonomy,jit,lock,kernel,heal,resource"
+#: rule id → checker-design.md anchor for SARIF helpUri (§18 documents
+#: the graftsync tier; the earlier tiers are §6/§7).
+RULE_HELP = {
+    **{r: "doc/checker-design.md#6-soundness-invariants"
+       for a in ("taxonomy", "jit", "lock") for r in RULES[a]},
+    **{r: "doc/checker-design.md#7-flow-invariants"
+       for a in ("kernel", "heal", "resource") for r in RULES[a]},
+    **{r: "doc/checker-design.md"
+          "#18-concurrency--crash-consistency-analyzers-graftsync"
+       for a in ("guarded", "lockorder", "crashproto", "envknobs")
+       for r in RULES[a]},
+}
+
+DEFAULT_RULES = ("taxonomy,jit,lock,kernel,heal,resource,"
+                 "guarded,lockorder,crashproto,envknobs")
 
 
 def repo_root() -> Path:
@@ -88,8 +115,12 @@ def run(paths: List[str], rules: List[str],
             else:
                 found = mod.analyze_file(f)
             for finding in found:
-                findings.append(Finding(relpath, finding.line,
-                                        finding.rule, finding.message))
+                # honor the finding's own path when the analyzer looked
+                # beyond the anchor file (lockorder loads the whole
+                # service/ tier from daemon.py)
+                findings.append(Finding(rel(finding.path, root),
+                                        finding.line, finding.rule,
+                                        finding.message))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
@@ -116,6 +147,10 @@ def main(argv=None) -> int:
     parser.add_argument("--vmem-budget", type=int,
                         default=DEFAULT_VMEM_BUDGET, metavar="BYTES",
                         help="kernel-contract per-program VMEM budget")
+    parser.add_argument("--knob-registry", default=None, metavar="FILE",
+                        help="write the JGRAFT_* env-knob registry "
+                             "harvested by the envknobs analyzer as "
+                             "JSON to FILE")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -147,6 +182,22 @@ def main(argv=None) -> int:
                              if p.exists())]
     findings = run(paths, rules, vmem_budget=args.vmem_budget)
 
+    # The knob registry is a whole-repo harvest (it also covers bench.py
+    # and the scripts, which the per-file walk does not visit) — run it
+    # on any default-path envknobs run, and whenever the artifact is
+    # requested explicitly.
+    if args.knob_registry or ("envknobs" in rules and not args.paths):
+        registry, extra = envknobs.build_registry(repo_root())
+        if "envknobs" in rules and not args.paths:
+            findings = sorted(findings + extra,
+                              key=lambda f: (f.path, f.line, f.rule))
+        if args.knob_registry:
+            Path(args.knob_registry).write_text(
+                json.dumps(registry, indent=2, sort_keys=True) + "\n",
+                encoding="utf-8")
+            print(f"env-knob registry: {len(registry['knobs'])} knob(s) "
+                  f"-> {args.knob_registry}", file=sys.stderr)
+
     fps = report.fingerprints(findings, repo_root())
     baseline_path: Optional[Path] = (
         Path(args.baseline) if args.baseline else default_baseline())
@@ -171,7 +222,8 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         rule_ids = [r for a in rules for r in RULES[a]]
-        print(json.dumps(report.to_sarif(findings, suppressed, rule_ids),
+        print(json.dumps(report.to_sarif(findings, suppressed, rule_ids,
+                                         rule_help=RULE_HELP),
                          indent=2))
     else:
         for f in new:

@@ -77,8 +77,12 @@ def save_baseline(path, fps: Sequence[str]) -> None:
 
 
 def to_sarif(findings: Sequence[Finding], baselined: Sequence[bool],
-             rule_ids: Sequence[str]) -> dict:
-    """One-run SARIF log; `baselined[i]` marks finding i suppressed."""
+             rule_ids: Sequence[str],
+             rule_help: Dict[str, str] = None) -> dict:
+    """One-run SARIF log; `baselined[i]` marks finding i suppressed.
+    `rule_help` maps rule ids to helpUri anchors (checker-design.md
+    sections) so code-scanning UIs link each finding to the invariant
+    it enforces."""
     results = []
     for f, sup in zip(findings, baselined):
         results.append({
@@ -104,7 +108,10 @@ def to_sarif(findings: Sequence[Finding], baselined: Sequence[bool],
                 "name": "graftlint",
                 "informationUri":
                     "doc/checker-design.md#6-soundness-invariants",
-                "rules": [{"id": r} for r in sorted(set(rule_ids))],
+                "rules": [
+                    {"id": r, **({"helpUri": rule_help[r]}
+                                 if rule_help and r in rule_help else {})}
+                    for r in sorted(set(rule_ids))],
             }},
             "results": results,
         }],

@@ -85,6 +85,20 @@ def env_float(name: str, default: float,
     return val
 
 
+def env_str(name: str, default: str = "") -> str:
+    """String twin of `env_int`/`env_float` for path/id knobs
+    (JGRAFT_CLUSTER_DIR, JGRAFT_REPLICA_ID, ...): a missing OR
+    blank/whitespace value falls back to the default, so
+    `JGRAFT_CLUSTER_DIR=""` in a wrapper script means "unset", not "the
+    current directory". Registered as a typed knob by the envknobs
+    analyzer (lint/flow/envknobs.py), which is why string knobs should
+    route through here rather than raw os.environ.get."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip()
+
+
 def pin_cpu(n_devices: int = 8) -> None:
     """Force JAX onto a virtual `n_devices`-device CPU platform.
 

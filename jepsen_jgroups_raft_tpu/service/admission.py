@@ -66,9 +66,9 @@ class AdmissionQueue:
     def __init__(self, capacity: Optional[int] = None,
                  on_prune: Optional[Callable[[CheckRequest], None]] = None):
         self.capacity = capacity if capacity is not None else queue_capacity()
-        self._pending: List[CheckRequest] = []
+        self._pending: List[CheckRequest] = []  # guarded_by(_cond)
         self._cond = threading.Condition()
-        self._closed = False
+        self._closed = False  # guarded_by(_cond)
         #: called (outside the lock) for each cancelled entry pruned out.
         self._on_prune = on_prune
 
@@ -169,7 +169,7 @@ class ResultCache:
                          else env_int("JGRAFT_SERVICE_CACHE", 256,
                                       minimum=0))
         self._lock = threading.Lock()
-        self._entries: OrderedDict = OrderedDict()
+        self._entries: OrderedDict = OrderedDict()  # guarded_by(_lock)
 
     def get(self, fingerprint: str) -> Optional[List[dict]]:
         with self._lock:

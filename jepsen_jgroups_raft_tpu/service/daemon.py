@@ -60,8 +60,8 @@ class _ShardQueue:
 
     def __init__(self):
         self._cond = threading.Condition()
-        self._items: deque = deque()
-        self._closed = False
+        self._items: deque = deque()  # guarded_by(_cond)
+        self._closed = False  # guarded_by(_cond)
 
     def put(self, item) -> bool:
         with self._cond:
@@ -140,10 +140,13 @@ def default_watchdog_margin() -> float:
     deadline+margin requeues the request; strike two at
     deadline+2·margin retries it solo via the bounded host ladder
     (`check_encoded_host`) so a wedged device launch can never park a
-    shard queue forever."""
-    from ..platform import env_int
+    shard queue forever. Parsed as a float: sub-second margins are how
+    the watchdog tests keep their wall clock down, and the old
+    `float(env_int(...))` form silently discarded `0.5` to the
+    default."""
+    from ..platform import env_float
 
-    return float(env_int("JGRAFT_SERVICE_WATCHDOG_S", 30, minimum=0))
+    return env_float("JGRAFT_SERVICE_WATCHDOG_S", 30.0, minimum=0.0)
 
 
 class CheckingService:
@@ -190,15 +193,16 @@ class CheckingService:
         #: Keyed by THREAD (not shard): after a watchdog replacement
         #: the zombie and its successor coexist briefly, and the
         #: zombie's cleanup must not clobber the successor's record.
-        self._inflight_by_thread: dict = {}
-        self._requests: dict = {}
-        self._terminal: deque = deque()  # finished ids, oldest first
+        self._inflight_by_thread: dict = {}  # guarded_by(_lock)
+        self._requests: dict = {}  # guarded_by(_lock)
+        # finished ids, oldest first
+        self._terminal: deque = deque()  # guarded_by(_lock)
         self._retain = retain_capacity()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._started = False
         self._worker: Optional[threading.Thread] = None
-        self._latencies: deque = deque(maxlen=LATENCY_WINDOW)
+        self._latencies: deque = deque(maxlen=LATENCY_WINDOW)  # guarded_by(_lock)
         # Durability/resilience tier (ISSUE 8).
         self.crash_cap = (crash_cap if crash_cap is not None
                           else default_crash_cap())
@@ -208,9 +212,9 @@ class CheckingService:
         self._watchdog: Optional[threading.Thread] = None
         #: fingerprint → live (queued/running) primary request, and
         #: primary id → attached idempotent-duplicate followers.
-        self._primary_by_fp: dict = {}
-        self._followers: dict = {}
-        self._stats = {
+        self._primary_by_fp: dict = {}  # guarded_by(_lock)
+        self._followers: dict = {}  # guarded_by(_lock)
+        self._stats = {  # guarded_by(_lock)
             "submitted": 0, "completed": 0, "failed": 0, "cancelled": 0,
             "rejected": 0, "cache_hits": 0, "batches": 0, "batch_rows": 0,
             "batched_requests": 0, "degraded_batches": 0,
@@ -231,7 +235,7 @@ class CheckingService:
         #: over every demuxed verdict) — the fleet capacity-model
         #: metric, merged per batch and served by /stats. Kept outside
         #: _stats so _count's int arithmetic never sees a dict.
-        self._tier_counts: dict = {}
+        self._tier_counts: dict = {}  # guarded_by(_lock)
         self._service_time_s = 1.0  # EWMA of per-request service time
         # Cluster tier (ISSUE 11): constructed only when a cluster dir
         # is configured — the single-replica daemon never imports the
@@ -241,19 +245,18 @@ class CheckingService:
         # restarting replica's peers do not claim the WAL it is
         # replaying).
         self.cluster = None
+        from ..platform import env_str
+
         cdir = (cluster_dir if cluster_dir is not None else
-                os.environ.get("JGRAFT_SERVICE_CLUSTER_DIR", "").strip()
-                or None)
+                env_str("JGRAFT_SERVICE_CLUSTER_DIR") or None)
         if cdir:
             from .cluster import ClusterManager
 
             rid = (replica_id
-                   or os.environ.get("JGRAFT_SERVICE_REPLICA_ID",
-                                     "").strip()
+                   or env_str("JGRAFT_SERVICE_REPLICA_ID")
                    or f"{self.name}-{os.getpid()}")
             url = (advertise_url
-                   or os.environ.get("JGRAFT_SERVICE_ADVERTISE_URL",
-                                     "").strip() or None)
+                   or env_str("JGRAFT_SERVICE_ADVERTISE_URL") or None)
             self.cluster = ClusterManager(self, cdir, rid, url=url,
                                           lease_ttl=lease_ttl_s,
                                           autostart=autostart)
@@ -303,8 +306,9 @@ class CheckingService:
         try:
             root.mkdir(parents=True, exist_ok=True)
             # shutil.move survives a cross-filesystem store/cluster
-            # split, where os.replace would EXDEV
-            shutil.move(str(legacy), str(target))
+            # split, where os.replace would EXDEV; startup-only (runs
+            # before worker threads or peers can race the WAL path)
+            shutil.move(str(legacy), str(target))  # lint: allow(nonatomic-publish)
             LOG.warning("%s: migrated legacy journal %s into the "
                         "cluster layout at %s", self.name, legacy,
                         target)
@@ -1196,13 +1200,26 @@ class CheckingService:
             d = self.store_root / self.name / f"{ts}-{req.id}"
             d.mkdir(parents=True, exist_ok=True)
             payload = _jsonable(req.to_dict())
-            with open(d / "results.json", "w") as f:
+            # Temp-write + os.replace: core/serve.py can list the run
+            # dir mid-write, so the publish must be atomic — a reader
+            # never parses a torn results.json. No fsync, though: the
+            # trace is best-effort (a power cut may lose it); the
+            # authoritative terminal record is the store entry
+            # _retire published, which store._publish fsyncs.
+            tmp = d / "results.json.tmp"
+            with open(tmp, "w") as f:
                 json.dump(payload, f, indent=2)
-            with open(d / "history.jsonl", "w") as f:
+            os.replace(tmp, d / "results.json")
+            tmp = d / "history.jsonl.tmp"
+            with open(tmp, "w") as f:
                 for label, hist in req.units:
                     for op in hist:
                         row = dict(op.to_dict(), unit=label)
-                        f.write(json.dumps(_jsonable(row)) + "\n")
+                        row_line = json.dumps(_jsonable(row)) + "\n"
+                        # best-effort trace: atomic via the replace
+                        # below, durability deliberately not promised
+                        f.write(row_line)  # lint: allow(fsync)
+            os.replace(tmp, d / "history.jsonl")
         except OSError:
             self._count("trace_errors")
             LOG.warning("trace write failed for request %s", req.id,

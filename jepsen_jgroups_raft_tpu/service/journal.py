@@ -304,16 +304,17 @@ class AdmissionJournal:
                        else env_int("JGRAFT_SERVICE_RETAIN", 1024,
                                     minimum=1))
         self._lock = threading.Lock()
-        self._fh = None
-        self._errors = 0
-        self._appends = 0
+        self._fh = None  # guarded_by(_lock)
+        self._errors = 0  # guarded_by(_lock)
+        self._appends = 0  # guarded_by(_lock)
         # group commit (ISSUE 15): pending entries + leader election.
         # _gcond guards _gqueue/_gleader; the IO itself runs under
         # _lock like every other write, so compaction/stats never
         # interleave with a group's write+fsync.
         self._gcond = threading.Condition(threading.Lock())
-        self._gqueue: List[list] = []   # [line, done, ok] per entry
-        self._gleader = False
+        # [line, done, ok] per entry
+        self._gqueue: List[list] = []  # guarded_by(_gcond)
+        self._gleader = False  # guarded_by(_gcond)
         self._glast_multi = False   # previous group carried riders?
         self._group_commits = 0
         self._group_records = 0
@@ -326,7 +327,7 @@ class AdmissionJournal:
 
     # ------------------------------------------------------------ write
 
-    def _handle(self):
+    def _handle(self):  # requires(_lock)
         if self._fh is None or self._fh.closed:
             self._fh = open(self.path, "ab")
         return self._fh

@@ -92,7 +92,7 @@ class ShardLoads:
 
     def __init__(self, n_shards: int):
         self.n_shards = max(1, int(n_shards))
-        self._loads = [0] * self.n_shards
+        self._loads = [0] * self.n_shards  # guarded_by(_lock)
         self._lock = threading.Lock()
 
     def least_loaded(self) -> int:
@@ -191,7 +191,7 @@ class BatchScheduler:
                            else batch_wait_s())
         self.aging_cap_s = aging_cap_s
         self.queue = queue
-        self._seq = 0
+        self._seq = 0  # guarded_by(_seq_lock)
         self._seq_lock = threading.Lock()
 
     # ------------------------------------------------------ formation

@@ -62,8 +62,9 @@ STORE_VERSION = 1
 def cluster_dir() -> Optional[str]:
     """The configured shared cluster directory, or None (the inert
     default — single-replica graftd)."""
-    raw = os.environ.get("JGRAFT_SERVICE_CLUSTER_DIR", "").strip()
-    return raw or None
+    from ..platform import env_str
+
+    return env_str("JGRAFT_SERVICE_CLUSTER_DIR") or None
 
 
 def _crc_entry(rec: dict) -> str:
@@ -98,7 +99,8 @@ class ResultStore:
     def __init__(self, root):
         self.root = Path(root)
         self._lock = threading.Lock()
-        self._counters = {"store_get_hits": 0, "store_get_misses": 0,
+        self._counters = {"store_get_hits": 0,  # guarded_by(_lock)
+                          "store_get_misses": 0,
                           "store_put_writes": 0, "store_put_discards": 0,
                           "store_corrupt_skipped": 0, "store_io_errors": 0}
         try:
@@ -246,8 +248,9 @@ def detail_store() -> Optional[ResultStore]:
     ``JGRAFT_RESULT_STORE`` (a store dir shared across the pod's hosts)
     falling back to the cluster dir; None — the inert default — keeps
     remote rows as the PR 7 verdict-code stubs."""
-    raw = (os.environ.get("JGRAFT_RESULT_STORE", "").strip()
-           or cluster_dir())
+    from ..platform import env_str
+
+    raw = env_str("JGRAFT_RESULT_STORE") or cluster_dir()
     if not raw:
         return None
     with _DETAIL_STORE_LOCK:

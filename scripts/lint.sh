@@ -9,9 +9,14 @@
 #                   contracts, nemesis fault↔heal pairing, resource leaks
 #                   across exception paths; gated on the checked-in
 #                   baseline (lint/baseline.json) so only REGRESSIONS fail.
-#   4. make tidy  — curated clang-tidy over native/src (self-skipping when
+#   4. graftsync  — the concurrency + crash-consistency tier (ISSUE 16):
+#                   guarded_by lock discipline, lock-order cycles against
+#                   the documented hierarchy, WAL fsync/atomic-publish
+#                   protocol, and the JGRAFT_* env-knob registry (emitted
+#                   as build/knob_registry.json).
+#   5. make tidy  — curated clang-tidy over native/src (self-skipping when
 #                   clang-tidy is absent, same pattern as SKIP_TSAN=1).
-# Stages 2-3 are pure stdlib (no jax import) so they never need skipping.
+# Stages 2-4 are pure stdlib (no jax import) so they never need skipping.
 # Exit nonzero on any finding. tests/test_lint.py + tests/test_lint_flow.py
 # keep stages 2-3 green by construction (self-hosting: the suite lints the
 # repo that contains it).
@@ -31,6 +36,14 @@ python -m jepsen_jgroups_raft_tpu.lint --rules taxonomy,jit,lock
 echo "== graftcheck (CFG/dataflow tier) =="
 python -m jepsen_jgroups_raft_tpu.lint --rules kernel,heal,resource \
     --baseline jepsen_jgroups_raft_tpu/lint/baseline.json
+
+echo "== graftsync (concurrency + crash-consistency tier) =="
+mkdir -p build
+python -m jepsen_jgroups_raft_tpu.lint \
+    --rules guarded,lockorder,crashproto,envknobs \
+    --baseline jepsen_jgroups_raft_tpu/lint/baseline.json \
+    --knob-registry build/knob_registry.json
+test -s build/knob_registry.json  # the registry artifact must exist
 
 echo "== clang-tidy =="
 make -C native tidy

@@ -599,17 +599,20 @@ class TestServiceResourceScope:
             assert resource.analyze_source(src) == [], f
 
     def test_trace_handle_mutation_fires(self):
-        # daemon._write_trace holds the results.json handle in a
-        # `with`; demoting it to a bare open() must re-arm the analyzer
-        # on the REAL source (the exception edge out of json.dump then
-        # escapes without a close).
+        # daemon._write_trace holds the results.json temp-file handle
+        # in a `with` (the publish is temp-write + os.replace since the
+        # crash-consistency pass); demoting it to a bare open() must
+        # re-arm the analyzer on the REAL source (the exception edge
+        # out of json.dump then escapes without a close).
         text = (PKG / "service" / "daemon.py").read_text()
-        managed = ('with open(d / "results.json", "w") as f:\n'
+        managed = ('tmp = d / "results.json.tmp"\n'
+                   '            with open(tmp, "w") as f:\n'
                    '                json.dump(payload, f, indent=2)')
         assert managed in text  # the mutation target must exist
         mutated = text.replace(
             managed,
-            'f = open(d / "results.json", "w")\n'
+            'tmp = d / "results.json.tmp"\n'
+            '            f = open(tmp, "w")\n'
             '            json.dump(payload, f, indent=2)')
         assert mutated != text
         found = resource.analyze_source(
