@@ -29,13 +29,15 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 from typing import List, Optional
 
 from . import jit_hygiene, lock_discipline, report, taxonomy
 from .base import Finding, collect_files, rel
-from .flow import crashproto, envknobs, guarded, heal, kernel_contract, \
-    lockorder, resource
+from .flow import crashproto, degraded, envknobs, fingerprint, guarded, \
+    heal, kernel_contract, knobclass, lockorder, lockstep, resource, \
+    tierstamp
 from .flow.kernel_contract import DEFAULT_VMEM_BUDGET
 
 #: name → (module, suffixes)
@@ -51,6 +53,12 @@ ANALYZERS = {
     "lockorder": (lockorder, (".py",)),
     "crashproto": (crashproto, (".py",)),
     "envknobs": (envknobs, (".py",)),
+    # graftgate tier (ISSUE 17): verdict-integrity dataflow
+    "fingerprint": (fingerprint, (".py",)),
+    "degraded": (degraded, (".py",)),
+    "knobclass": (knobclass, (".py",)),
+    "tierstamp": (tierstamp, (".py",)),
+    "lockstep": (lockstep, (".py",)),
 }
 
 RULES = {
@@ -71,6 +79,11 @@ RULES = {
                    "flow-nonatomic-publish"),
     "envknobs": ("flow-env-raw-parse", "flow-env-undocumented",
                  "flow-env-dup-default"),
+    "fingerprint": ("flow-fp-unhashed", "flow-fp-rung-mismatch"),
+    "degraded": ("flow-degraded-sink",),
+    "knobclass": ("flow-knob-unclassified", "flow-knob-verdict"),
+    "tierstamp": ("flow-tier-unstamped",),
+    "lockstep": ("flow-lockstep-drift", "flow-lockstep-anchor"),
 }
 
 #: rule id → checker-design.md anchor for SARIF helpUri (§18 documents
@@ -84,10 +97,16 @@ RULE_HELP = {
           "#18-concurrency--crash-consistency-analyzers-graftsync"
        for a in ("guarded", "lockorder", "crashproto", "envknobs")
        for r in RULES[a]},
+    **{r: "doc/checker-design.md"
+          "#19-verdict-integrity-dataflow-analyzers-graftgate"
+       for a in ("fingerprint", "degraded", "knobclass", "tierstamp",
+                 "lockstep")
+       for r in RULES[a]},
 }
 
 DEFAULT_RULES = ("taxonomy,jit,lock,kernel,heal,resource,"
-                 "guarded,lockorder,crashproto,envknobs")
+                 "guarded,lockorder,crashproto,envknobs,"
+                 "fingerprint,degraded,knobclass,tierstamp,lockstep")
 
 
 def repo_root() -> Path:
@@ -99,11 +118,13 @@ def default_baseline() -> Path:
 
 
 def run(paths: List[str], rules: List[str],
-        vmem_budget: int = DEFAULT_VMEM_BUDGET) -> List[Finding]:
+        vmem_budget: int = DEFAULT_VMEM_BUDGET,
+        timings: Optional[dict] = None) -> List[Finding]:
     root = repo_root()
     explicit = {Path(p).resolve() for p in paths if Path(p).is_file()}
     findings: List[Finding] = []
     for name in rules:
+        t0 = time.perf_counter()
         mod, suffixes = ANALYZERS[name]
         for f in collect_files(paths, suffixes):
             relpath = rel(f, root)
@@ -121,6 +142,9 @@ def run(paths: List[str], rules: List[str],
                 findings.append(Finding(rel(finding.path, root),
                                         finding.line, finding.rule,
                                         finding.message))
+        if timings is not None:
+            timings[name] = timings.get(name, 0.0) + \
+                (time.perf_counter() - t0)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
@@ -151,6 +175,9 @@ def main(argv=None) -> int:
                         help="write the JGRAFT_* env-knob registry "
                              "harvested by the envknobs analyzer as "
                              "JSON to FILE")
+    parser.add_argument("--timing", action="store_true",
+                        help="emit per-analyzer wall seconds to stderr "
+                             "(the lint.yml budget assert reads this)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -180,7 +207,9 @@ def main(argv=None) -> int:
                            *(str(p) for p in
                              [repo_root() / "scripts" / "chaos_graftd.py"]
                              if p.exists())]
-    findings = run(paths, rules, vmem_budget=args.vmem_budget)
+    timings: Optional[dict] = {} if args.timing else None
+    findings = run(paths, rules, vmem_budget=args.vmem_budget,
+                   timings=timings)
 
     # The knob registry is a whole-repo harvest (it also covers bench.py
     # and the scripts, which the per-file walk does not visit) — run it
@@ -197,6 +226,13 @@ def main(argv=None) -> int:
                 encoding="utf-8")
             print(f"env-knob registry: {len(registry['knobs'])} knob(s) "
                   f"-> {args.knob_registry}", file=sys.stderr)
+
+    if timings is not None:
+        for name in sorted(timings, key=timings.get, reverse=True):
+            print(f"lint-timing: {name} {timings[name]:.3f}s",
+                  file=sys.stderr)
+        print(f"lint-timing: total {sum(timings.values()):.3f}s",
+              file=sys.stderr)
 
     fps = report.fingerprints(findings, repo_root())
     baseline_path: Optional[Path] = (

@@ -271,6 +271,14 @@ def build_registry(root) -> Tuple[dict, List[Finding]]:
             for fnd in analyze_source(src, doc_names=doc):
                 findings.append(Finding(relp, fnd.line, fnd.rule,
                                         fnd.message))
+    # graftgate columns (ISSUE 17 satellite 1): classification from the
+    # knobclass table, and whether the knob's value data-flows into any
+    # verdict expression (imported lazily — knobclass imports this
+    # module for the harvest helpers).
+    from .knobclass import knob_class, verdict_taint
+
+    reachable = verdict_taint({relp: s for relp, s in srcs.items()
+                               if relp.endswith(".py")})
     registry: Dict[str, dict] = {}
     for name in sorted(knobs):
         sites = sorted(knobs[name], key=lambda s: (s[0], s[1].line))
@@ -299,6 +307,8 @@ def build_registry(root) -> Tuple[dict, List[Finding]]:
         registry[name] = {
             "type": (typed[0][1].via.replace("env_", "")
                      if typed else "raw"),
+            "class": knob_class(name),
+            "verdict_reachable": bool(reachable.get(name, False)),
             "documented": (name in doc) if doc is not None else None,
             "sites": [{
                 "path": relp, "line": r.line, "via": r.via,
@@ -306,7 +316,7 @@ def build_registry(root) -> Tuple[dict, List[Finding]]:
                 **({"minimum": r.minimum} if r.minimum is not None else {}),
             } for relp, r in sites],
         }
-    reg = {"version": 1,
+    reg = {"version": 2,
            "comment": "JGRAFT_* env-knob registry harvested by the "
                       "envknobs analyzer; regenerate with "
                       "python -m jepsen_jgroups_raft_tpu.lint "

@@ -372,7 +372,8 @@ def _remote_result(code: int, owner: int) -> dict:
     valid = (VALID if code == _CODE_VALID
              else INVALID if code == _CODE_INVALID else UNKNOWN)
     return {"valid?": valid, "algorithm": "jax",
-            "kernel": "remote-shard", "process": owner}
+            "kernel": "remote-shard", "process": owner,
+            "decided-tier": "remote-shard"}
 
 
 def _detail_exchange(model, algorithm: str):

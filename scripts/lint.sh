@@ -14,9 +14,13 @@
 #                   the documented hierarchy, WAL fsync/atomic-publish
 #                   protocol, and the JGRAFT_* env-knob registry (emitted
 #                   as build/knob_registry.json).
-#   5. make tidy  — curated clang-tidy over native/src (self-skipping when
+#   5. graftgate  — the verdict-integrity dataflow tier (ISSUE 17):
+#                   fingerprint completeness, degraded-result quarantine,
+#                   routing/verdict knob separation, tier-stamp totality,
+#                   and the duplicated-certifier lock-step tripwire.
+#   6. make tidy  — curated clang-tidy over native/src (self-skipping when
 #                   clang-tidy is absent, same pattern as SKIP_TSAN=1).
-# Stages 2-4 are pure stdlib (no jax import) so they never need skipping.
+# Stages 2-5 are pure stdlib (no jax import) so they never need skipping.
 # Exit nonzero on any finding. tests/test_lint.py + tests/test_lint_flow.py
 # keep stages 2-3 green by construction (self-hosting: the suite lints the
 # repo that contains it).
@@ -44,6 +48,11 @@ python -m jepsen_jgroups_raft_tpu.lint \
     --baseline jepsen_jgroups_raft_tpu/lint/baseline.json \
     --knob-registry build/knob_registry.json
 test -s build/knob_registry.json  # the registry artifact must exist
+
+echo "== graftgate (verdict-integrity tier) =="
+python -m jepsen_jgroups_raft_tpu.lint \
+    --rules fingerprint,degraded,knobclass,tierstamp,lockstep \
+    --baseline jepsen_jgroups_raft_tpu/lint/baseline.json --timing
 
 echo "== clang-tidy =="
 make -C native tidy

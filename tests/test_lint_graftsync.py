@@ -481,7 +481,7 @@ class TestEnvKnobs:
         registry, findings = envknobs.build_registry(REPO)
         assert not findings, findings
         knobs = registry["knobs"]
-        assert registry["version"] == 1
+        assert registry["version"] == 2  # PR-17 adds class columns
         # the PR 12-15 knobs the audit reconciled are all present,
         # typed, and documented
         for name in ("JGRAFT_SERVICE_WATCHDOG_S", "JGRAFT_BENCH_REPS",
@@ -561,7 +561,7 @@ class TestCliWorkflow:
         capsys.readouterr()
         assert rc == 0
         reg = json.loads(reg_file.read_text())
-        assert reg["version"] == 1 and reg["knobs"]
+        assert reg["version"] == 2 and reg["knobs"]
         site = reg["knobs"]["JGRAFT_SERVICE_WATCHDOG_S"]["sites"][0]
         assert site["via"] == "env_float"
         assert site["path"].endswith("service/daemon.py")
