@@ -1052,6 +1052,10 @@ def run_service(platform_note: str) -> None:
     n_hists = env_int("JGRAFT_SERVICE_BENCH_HISTORIES", 4, minimum=1)
     n_ops = env_int("JGRAFT_SERVICE_BENCH_OPS", 200, minimum=1)
     n_clients = env_int("JGRAFT_SERVICE_BENCH_CLIENTS", 8, minimum=1)
+    # ISSUE-18 transports: --binary submits columnar frames instead of
+    # JSON bodies; --uds drives the daemon over the same-host
+    # unix-socket lane instead of TCP loopback.
+    use_binary = "--binary" in sys.argv
 
     rng = _random.Random(20260803)
     # Per-request distinct histories: identical payloads would measure
@@ -1087,8 +1091,20 @@ def run_service(platform_note: str) -> None:
     _CLEANUP.append(httpd.server_close)
     _CLEANUP.append(service.shutdown)
     _CLEANUP.append(rm_journal_tmp)
+    uds_httpd = None
+    if "--uds" in sys.argv:
+        from jepsen_jgroups_raft_tpu.service.http import serve_uds_in_thread
 
-    def wave(pool=None, expect_valid=True):
+        uds_sock = os.path.join(
+            tempfile.mkdtemp(prefix="graftd-bench-uds-"), "graftd.sock")
+        uds_httpd, _ut = serve_uds_in_thread(service, uds_sock)
+        client_url = "unix:" + uds_sock
+        _CLEANUP.append(uds_httpd.server_close)
+    # keep-alive evidence (ISSUE-18 satellite): connections opened vs
+    # reused across every submitter client in every wave.
+    conn_totals = {"opened": 0, "reused": 0}
+
+    def wave(pool=None, expect_valid=True, binary=None):
         """One rep: n_requests submitted from n_clients threads, every
         verdict awaited. Returns (wall_s, latencies, rejected,
         stats_delta) — the daemon counters are snapshotted per wave so
@@ -1096,8 +1112,10 @@ def run_service(platform_note: str) -> None:
         time_s/req_s, not an accumulation across all best_of reps.
         `pool` overrides the request payloads (the ISSUE-14 fast-lane
         A/B drives a mixed valid/invalid stream, where only the DONE
-        status is asserted, not the verdict)."""
+        status is asserted, not the verdict); `binary` overrides the
+        --binary transport choice (the ISSUE-18 transport A/B)."""
         pool = payloads if pool is None else pool
+        bin_arm = use_binary if binary is None else binary
         s0 = service.stats()
         latencies: list = []
         rejected = [0]
@@ -1110,11 +1128,15 @@ def run_service(platform_note: str) -> None:
                 with lock:
                     i = next(idx, None)
                 if i is None:
+                    with lock:
+                        conn_totals["opened"] += cl.conn_opened
+                        conn_totals["reused"] += cl.conn_reused
                     return
                 t0 = time.perf_counter()
                 while True:
                     try:
-                        rec = cl.submit(pool[i], workload="register")
+                        rec = cl.submit(pool[i], workload="register",
+                                        binary=bin_arm)
                         break
                     except ServiceError as e:
                         if e.status != 429:
@@ -1246,6 +1268,28 @@ def run_service(platform_note: str) -> None:
             "journal_group_speedup": round(
                 min(times_ab[False]) / min(times_ab[True]), 3),
         }
+    # ISSUE-18 transport A/B: same daemon, same payload pool, binary
+    # columnar frames vs JSON bodies, interleaved in THIS process.
+    # End-to-end req/s (ingest + verdict); the ingest-isolated claim
+    # lives in scripts/ab_ingest.py. JGRAFT_SERVICE_BENCH_INGESTAB=0
+    # skips the phase.
+    ingest_fields: dict = {}
+    if os.environ.get("JGRAFT_SERVICE_BENCH_INGESTAB", "1") != "0":
+        t_ab: dict = {True: [], False: []}
+        for rep in range(2):           # interleaved, order rotated
+            order = (True, False) if rep % 2 == 0 else (False, True)
+            for b in order:
+                w, _, _, _ = wave(binary=b)
+                t_ab[b].append(w)
+                beat()
+        ingest_fields = {
+            "transport_binary_req_s": round(
+                n_requests / min(t_ab[True]), 2),
+            "transport_json_req_s": round(
+                n_requests / min(t_ab[False]), 2),
+            "transport_binary_speedup": round(
+                min(t_ab[False]) / min(t_ab[True]), 3),
+        }
     # Group-commit gauges only: taken AFTER the A/B phases (they are
     # process-lifetime counters, so later is more complete), but kept
     # out of `stats` — the row's journal_append_p50_ms /
@@ -1256,6 +1300,10 @@ def run_service(platform_note: str) -> None:
 
     httpd.shutdown()
     httpd.server_close()
+    if uds_httpd is not None:
+        uds_httpd.shutdown()
+        uds_httpd.server_close()
+        _CLEANUP.remove(uds_httpd.server_close)
     service.shutdown(wait=True)
     rm_journal_tmp()
     _CLEANUP.remove(httpd.server_close)
@@ -1319,6 +1367,14 @@ def run_service(platform_note: str) -> None:
         # (lane on vs JGRAFT_LIN_FASTPATH=0, interleaved; empty when
         # JGRAFT_SERVICE_BENCH_FASTLANE=0 skips the phase).
         **fastlane_fields,
+        # ISSUE-18 transport evidence: which lane/encoding the MAIN
+        # timed run used, the keep-alive connection economy across all
+        # waves, and the same-process binary-vs-JSON A/B.
+        "transport": "uds" if uds_httpd is not None else "tcp",
+        "encoding": "binary" if use_binary else "json",
+        "conn_opened": conn_totals["opened"],
+        "conn_reused": conn_totals["reused"],
+        **ingest_fields,
         # Same host-drift armor as the batch rows (ISSUE-4 satellites):
         # best rep + full spread + cold/warm split + host fingerprint.
         "rep_times_s": [round(t, 3) for t in rep_times],

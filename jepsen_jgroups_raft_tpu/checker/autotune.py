@@ -56,7 +56,7 @@ import numpy as np
 from ..history.packing import (MACRO_MAX_OPENS, bucket_rows,
                                macro_events_on, pack_batch,
                                pack_macro_batch)
-from ..platform import env_float, env_int
+from ..platform import env_float, env_int, env_str
 
 _log = logging.getLogger(__name__)
 
@@ -640,32 +640,74 @@ def _linfp_path(sig: tuple) -> Path:
         f"linfp-{sig[1]}-e{sig[2]}.json"
 
 
-def _linfp_record(sig: tuple) -> dict:
-    """The bucket's in-memory record, seeded from the fingerprint store
-    on first touch. Corrupt/stale/foreign files mean 'start fresh,
-    never silently mis-gate' — same stance as `plan_for`."""
-    with _LOCK:
-        rec = _LINFP_MEM.get(sig)
-        if rec is not None:
-            return rec
-    fresh = {"rows": 0, "hits": 0, "certify_wall_s": 0.0}
-    path = _linfp_path(sig)
+def linfp_shared_dir() -> Optional[Path]:
+    """Shared gate-store directory (ISSUE 18 satellite): when
+    JGRAFT_LINFP_DIR (fallback: the cluster rendezvous dir,
+    JGRAFT_SERVICE_CLUSTER_DIR) names a directory every replica can
+    reach, lin-fastpath gate records replicate through
+    ``<dir>/linfp/`` so the fast path can re-enable inside distributed
+    wavefronts: every rank routes off the same published snapshot
+    instead of its private observation history. Unset → None (gating
+    stays host-local, wavefronts stay kernel-first)."""
+    raw = env_str("JGRAFT_LINFP_DIR", "").strip()
+    if not raw:
+        raw = (env_str("JGRAFT_SERVICE_CLUSTER_DIR") or "").strip()
+    if not raw:
+        return None
+    return Path(raw) / "linfp"
+
+
+def _linfp_shared_path(sig: tuple) -> Optional[Path]:
+    d = linfp_shared_dir()
+    if d is None:
+        return None
+    return d / f"linfp-{sig[1]}-e{sig[2]}.json"
+
+
+def _load_linfp(path: Path, sig: tuple, require_host: bool) -> \
+        Optional[dict]:
+    """Parse one gate record, or None. Shared records skip the
+    host-fingerprint check: the hit-RATE the gate routes on is a
+    property of the workload family, not the host (the wall figures
+    travel along but are advisory) — while host-local records keep the
+    strict check so a toolchain swap re-observes, exactly like plans."""
     try:
         raw = json.loads(path.read_text())
         if (raw.get("version") == LINFP_VERSION
-                and raw.get("fingerprint") == host_fingerprint()
-                and raw.get("signature") == list(sig)):
-            fresh = {"rows": int(raw["rows"]), "hits": int(raw["hits"]),
-                     "certify_wall_s": float(raw["certify_wall_s"])}
-        else:
-            _log.warning("autotune: stale lin-fastpath record %s — "
-                         "re-observing", path)
+                and raw.get("signature") == list(sig)
+                and (not require_host
+                     or raw.get("fingerprint") == host_fingerprint())):
+            return {"rows": int(raw["rows"]), "hits": int(raw["hits"]),
+                    "certify_wall_s": float(raw["certify_wall_s"])}
+        _log.warning("autotune: stale lin-fastpath record %s — "
+                     "re-observing", path)
     except FileNotFoundError:
         pass
     except (OSError, json.JSONDecodeError, UnicodeDecodeError,
             KeyError, TypeError, ValueError) as e:
         _log.warning("autotune: unreadable lin-fastpath record %s "
                      "(%s: %s) — re-observing", path, type(e).__name__, e)
+    return None
+
+
+def _linfp_record(sig: tuple) -> dict:
+    """The bucket's in-memory record, seeded on first touch from the
+    fingerprint store — or, when the local file is absent/stale, from
+    the shared gate dir, which is how a fresh replica inherits the
+    cluster's gate history instead of paying min_obs rows of
+    re-observation. Corrupt/stale/foreign files mean 'start fresh,
+    never silently mis-gate' — same stance as `plan_for`."""
+    with _LOCK:
+        rec = _LINFP_MEM.get(sig)
+        if rec is not None:
+            return rec
+    fresh = _load_linfp(_linfp_path(sig), sig, require_host=True)
+    if fresh is None:
+        shared = _linfp_shared_path(sig)
+        if shared is not None:
+            fresh = _load_linfp(shared, sig, require_host=False)
+    if fresh is None:
+        fresh = {"rows": 0, "hits": 0, "certify_wall_s": 0.0}
     with _LOCK:
         rec = _LINFP_MEM.setdefault(sig, fresh)
     return rec
@@ -712,15 +754,25 @@ def lin_fastpath_observe(sig: tuple, rows: int, hits: int,
             "updated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime()),
         }
-    path = _linfp_path(sig)
-    try:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(payload, indent=2))
-        os.replace(tmp, path)
-    except OSError as e:
-        _log.warning("autotune: could not persist lin-fastpath record "
-                     "%s (%s: %s)", path, type(e).__name__, e)
+    # publish locally AND (when configured) into the shared gate dir,
+    # so sibling replicas inherit the observation. Shared writes race
+    # last-writer-wins across replicas; each writer's record carries a
+    # complete, internally consistent observation history, so whichever
+    # lands is a valid gate input (per-pid tmp names keep the renames
+    # atomic and non-colliding).
+    targets = [_linfp_path(sig)]
+    shared = _linfp_shared_path(sig)
+    if shared is not None:
+        targets.append(shared)
+    for path in targets:
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(f".{os.getpid()}.tmp")
+            tmp.write_text(json.dumps(payload, indent=2))
+            os.replace(tmp, path)
+        except OSError as e:
+            _log.warning("autotune: could not persist lin-fastpath "
+                         "record %s (%s: %s)", path, type(e).__name__, e)
 
 
 def sort_rung_sharding(tuned: Optional[TunedPlan]):

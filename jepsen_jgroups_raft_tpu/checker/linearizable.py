@@ -445,18 +445,26 @@ def check_encoded(
 
     # Lin-rung pre-kernel fast path (ISSUE 14): certify on the host,
     # evict VALID rows from the batch BEFORE grouping/bucketing/
-    # chunked-wavefront. NOT inside an active distributed wavefront:
-    # the certify pass itself is deterministic, but the measured gate
+    # chunked-wavefront. Inside an active distributed wavefront the
+    # certify pass itself is deterministic, but the measured gate
     # (autotune linfp records) is HOST-LOCAL state — two cluster
     # processes with different gate histories would evict different
-    # rows and the SPMD collectives would mismatch. Sharded batches
-    # stay kernel-first until the gate store is shared (ROADMAP
-    # item 3's on-chip round); graftd's per-host lane is unaffected
+    # rows and the SPMD collectives would mismatch — so sharded
+    # batches stay kernel-first UNLESS the shared gate store
+    # (ISSUE 18: autotune.linfp_shared_dir, JGRAFT_LINFP_DIR /
+    # cluster-dir fallback) is configured: then every rank seeds its
+    # gate from the same published snapshot and routes identically.
+    # Residual race, documented: a publish landing between two ranks'
+    # FIRST touch of the same bucket can still diverge their routing —
+    # worst case a collective mismatch (an error/hang, i.e. liveness),
+    # never a verdict change. graftd's per-host lane is unaffected
     # (its scheduler pins distribute=False).
     distributing = (distribute and distributed.wavefront_active()
                     and len(encs) > 1)
+    gate_shared = autotune.linfp_shared_dir() is not None
     fp = None
-    if (lin_fastpath is not False and encs and not distributing
+    if (lin_fastpath is not False and encs
+            and (not distributing or gate_shared)
             and algorithm in LIN_FASTPATH_ALGOS and lin_fastpath_on()):
         fp = lin_fastpath_pass(encs, model)
         if not any(r is not None for r in fp):

@@ -64,7 +64,8 @@ class _LiveStreamFeeder:
                                timeout=float(cfg.get("timeout_s", 30.0)))
         self.session = StreamSession(
             client, workload=cfg.get("workload", "register"),
-            algorithm=cfg.get("algorithm", "auto"))
+            algorithm=cfg.get("algorithm", "auto"),
+            binary=bool(cfg.get("binary", False)))
         self.session.open()
         self._buf: list = []
         self._q: list = []
@@ -204,7 +205,8 @@ def run_test(test: dict) -> dict:
     hlock = threading.Lock()
 
     # Live streaming (ISSUE 12): `live_stream` is either a config dict
-    # ({"url": "http://host:port", "workload"?, "flush_ops"?}) or a
+    # ({"url": "http://host:port" | "unix:/path.sock", "workload"?,
+    # "flush_ops"?, "binary"?}) or a
     # ready feeder-like object with record/close. A feeder that fails
     # to OPEN degrades to no streaming — the run must not depend on the
     # monitor being up.

@@ -95,6 +95,10 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_LIN_FASTPATH_ABORT": ROUTING,
     "JGRAFT_LIN_FASTPATH_MIN_HIT": ROUTING,
     "JGRAFT_LIN_FASTPATH_MIN_OBS": ROUTING,
+    # shared lin-fastpath gate dir (ISSUE 18): where gate records
+    # replicate FROM decides which engine tries first — routing, like
+    # the rest of the linfp family; verdicts never depend on it.
+    "JGRAFT_LINFP_DIR": ROUTING,
     "JGRAFT_MACRO_EVENTS": ROUTING,
     "JGRAFT_MERGE_ALL": ROUTING,
     "JGRAFT_MERGE_LONG": ROUTING,
@@ -126,6 +130,7 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_BENCH_TARGET": OPS,
     "JGRAFT_BENCH_VDEVS": OPS,
     "JGRAFT_BENCH_WATCHDOG_S": OPS,
+    "JGRAFT_CLIENT_KEEPALIVE": OPS,
     "JGRAFT_CLUSTER_SKEW_S": OPS,
     "JGRAFT_CLUSTER_TTL_S": OPS,
     "JGRAFT_DISTRIBUTED_TIMEOUT_MS": OPS,
@@ -135,6 +140,7 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_SERVICE_BENCH_FASTLANE": OPS,
     "JGRAFT_SERVICE_BENCH_GROUPAB": OPS,
     "JGRAFT_SERVICE_BENCH_HISTORIES": OPS,
+    "JGRAFT_SERVICE_BENCH_INGESTAB": OPS,
     "JGRAFT_SERVICE_BENCH_OPS": OPS,
     "JGRAFT_SERVICE_BENCH_REQUESTS": OPS,
     "JGRAFT_SERVICE_CACHE": OPS,
@@ -142,6 +148,7 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_SERVICE_QUEUE": OPS,
     "JGRAFT_SERVICE_REPLICA_ID": OPS,
     "JGRAFT_SERVICE_SHED_DEPTH": OPS,
+    "JGRAFT_SERVICE_UDS": OPS,
     "JGRAFT_SERVICE_WATCHDOG_S": OPS,
     "JGRAFT_SERVICE_WORKERS": OPS,
     "JGRAFT_STREAM_BENCH_OPS": OPS,

@@ -89,6 +89,9 @@ HIERARCHY: Tuple[str, ...] = (
     # holds the registry lock while constructing/loading the store
     "store._DETAIL_STORE_LOCK",
     "ResultStore._lock",
+    # tenant-side leaf: keep-alive A/B counters (ISSUE 18) — bumped
+    # with nothing else held, never wraps an acquisition
+    "ServiceClient._counter_lock",
 )
 
 #: Method names too generic for unique-name call resolution (they exist

@@ -35,6 +35,11 @@ macro compaction) across many independent submissions:
                  chunk-scan re-entry, live mid-run violation surfacing,
                  WAL-backed crash resume and idle-park, per-session
                  flow-control budgets.
+* frame.py     — wire-speed ingest (ISSUE 18): length-prefixed binary
+                 columnar frames (client-side encode, zero-copy server
+                 decode, CRC-guarded); the server always re-derives the
+                 fingerprint, so a lying client corrupts only its own
+                 verdict.
 """
 
 from .admission import QueueFull, ServiceStopped  # noqa: F401

@@ -31,6 +31,28 @@ from .request import CheckRequest
 DEFAULT_QUEUE_CAP = 64
 
 
+def admit_frame(payload) -> CheckRequest:
+    """Binary-lane admission (ISSUE 18): decode a submit frame's
+    zero-copy tensor views and normalize them into a CheckRequest. The
+    fingerprint is re-derived server-side over the received bytes
+    (`request.admit_encoded`) — the lying-client argument lives there.
+    Raises `frame.FrameError` (a ValueError → HTTP 400) on malformed
+    frames, ValueError on unknown workloads/rungs exactly like the
+    JSON path's `admit`."""
+    from .frame import KIND_SUBMIT, FrameError, decode_frame
+    from .request import admit_encoded
+
+    fr = decode_frame(payload)
+    if getattr(fr, "labels", None) is None:
+        raise FrameError(f"expected a submit frame (kind {KIND_SUBMIT}); "
+                         "got a stream segment")
+    return admit_encoded(
+        workload=fr.workload, labels=fr.labels, encs=fr.encs,
+        algorithm=fr.algorithm, deadline_ms=fr.deadline_ms,
+        priority=fr.priority, consistency=fr.consistency,
+        claimed_fingerprint=fr.fingerprint)
+
+
 def queue_capacity() -> int:
     """Resolved admission-queue bound (JGRAFT_SERVICE_QUEUE; parsed
     defensively like every other env gate — garbage warns and keeps

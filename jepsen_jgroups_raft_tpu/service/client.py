@@ -2,9 +2,28 @@
 
 The tenant-side counterpart of service/http.py: tests, the bench's
 --service throughput mode, and any external submitter use this instead
-of hand-rolling requests. One connection per call (the daemon is
-ThreadingHTTPServer; connection reuse buys nothing at this scale and a
-stateless client survives daemon restarts for free).
+of hand-rolling requests.
+
+Connection reuse (ISSUE 18 satellite): calls keep-alive their
+connection per (thread, replica) and reuse it across submits, polls,
+and retries — at wire-speed ingest rates the TCP handshake per call is
+a measurable tax (the `conn_opened`/`conn_reused` counters are the
+bench's A/B evidence). The daemon speaks HTTP/1.1 persistent
+connections already; a STALE kept-alive socket (daemon restarted
+between calls) is retried once on a fresh connection without consuming
+the caller's attempt budget, so restart-survival is as good as the old
+connection-per-call stance. ``JGRAFT_CLIENT_KEEPALIVE=0`` restores
+that stance exactly (and is the bench's other arm).
+
+Binary ingest (ISSUE 18 tentpole): ``submit(..., binary=True)`` runs
+the pure `encode_history` LOCALLY and ships the packed int32 tensors
+as one `service/frame.py` columnar frame — no JSON op serialization,
+no server-side encode. `stream(..., binary=True)` does the same
+per-segment with a client-owned `IncrementalEncoder`. The server
+re-derives the fingerprint over the received bytes either way, so a
+corrupt client harms only its own verdict. Same-host producers can
+point `base_url` at ``unix:/path/to/graftd.sock`` (the daemon's
+JGRAFT_SERVICE_UDS listener) and skip the TCP stack entirely.
 
 Retry discipline (ISSUE 8): submission is IDEMPOTENT server-side — a
 resubmitted fingerprint attaches to the live request or hits the result
@@ -41,10 +60,14 @@ from __future__ import annotations
 import hashlib
 import json
 import random
+import socket
+import threading
 import time
 from collections import OrderedDict
 from http.client import HTTPConnection, HTTPException
 from typing import List, Optional, Sequence
+
+from ..platform import env_int
 
 #: Connection-level failures safe to retry once submission is
 #: idempotent (refused/reset/timeout — the daemon-restart signatures).
@@ -53,6 +76,34 @@ RETRYABLE_CONN_ERRORS = (ConnectionError, HTTPException, TimeoutError,
 
 #: HTTP statuses that carry a retry_after_s hint and mean "try later".
 RETRYABLE_STATUSES = (429, 503)
+
+#: Content-Type of binary columnar frames (mirrors service/http.py —
+#: not imported: the client must stay importable without dragging the
+#: daemon stack in).
+FRAME_CONTENT_TYPE = "application/x-jgraft-frame"
+
+
+def client_keepalive() -> bool:
+    """JGRAFT_CLIENT_KEEPALIVE gate (default on; 0 restores the
+    connection-per-call client — the bench's A/B arm)."""
+    return env_int("JGRAFT_CLIENT_KEEPALIVE", 1, minimum=0) != 0
+
+
+class _UDSConnection(HTTPConnection):
+    """http.client over an AF_UNIX socket — the client half of the
+    daemon's same-host lane (ISSUE 18; `service/http.py`
+    `_UnixHTTPServer`). The Host header is a dummy: HTTP routing over
+    a unix socket is by path, not name."""
+
+    def __init__(self, path: str, timeout=None):
+        super().__init__("localhost", timeout=timeout)
+        self._uds_path = path
+
+    def connect(self):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if self.timeout is not None:
+            self.sock.settimeout(self.timeout)
+        self.sock.connect(self._uds_path)
 
 
 class ServiceError(Exception):
@@ -89,7 +140,18 @@ def backoff_delay(attempt: int, base_s: float, cap_s: float,
     return delay
 
 
+def _close_quietly(conn: HTTPConnection) -> None:
+    try:
+        conn.close()
+    except OSError:
+        pass  # already dead — closing was the point
+
+
 def _netloc(url: str) -> str:
+    if url.startswith("unix:"):
+        # same-host lane: "unix:/abs/path/to/graftd.sock". The path is
+        # carried in the netloc verbatim behind the "unix:" sentinel.
+        return "unix:" + url[len("unix:"):]
     if "://" in url:
         url = url.split("://", 1)[1]
     return url.rstrip("/")
@@ -134,6 +196,59 @@ class ServiceClient:
         #: netloc that served the most recent successful _call (feeds
         #: the owner map; best-effort under concurrent use).
         self._answered_by: Optional[str] = None
+        #: per-THREAD keep-alive pool, netloc → live HTTPConnection.
+        #: Thread-local because http.client connections are not
+        #: thread-safe and the bench drives one client from many
+        #: submitter threads.
+        self._local = threading.local()
+        self._counter_lock = threading.Lock()
+        #: keep-alive A/B evidence (ISSUE 18 satellite): sockets dialed
+        #: vs. calls served on an already-open connection.
+        self.conn_opened = 0
+        self.conn_reused = 0
+
+    # ---------------------------------------------------- connections
+
+    def _connect(self, netloc: str) -> HTTPConnection:
+        if netloc.startswith("unix:"):
+            return _UDSConnection(netloc[len("unix:"):],
+                                  timeout=self.timeout)
+        return HTTPConnection(netloc, timeout=self.timeout)
+
+    def _pool(self) -> dict:
+        pool = getattr(self._local, "pool", None)
+        if pool is None:
+            pool = self._local.pool = {}
+        return pool
+
+    def _checkout(self, netloc: str, force_fresh: bool = False):
+        """(connection, was_reused) for one call. Reuse comes from this
+        thread's pool; `force_fresh` bypasses it (the stale-keep-alive
+        retry)."""
+        if client_keepalive() and not force_fresh:
+            conn = self._pool().pop(netloc, None)
+            if conn is not None:
+                with self._counter_lock:
+                    self.conn_reused += 1
+                return conn, True
+        with self._counter_lock:
+            self.conn_opened += 1
+        return self._connect(netloc), False
+
+    def _checkin(self, netloc: str, conn: HTTPConnection) -> None:
+        pool = self._pool()
+        old = pool.get(netloc)
+        if old is not None and old is not conn:
+            _close_quietly(old)
+        pool[netloc] = conn
+
+    def close(self) -> None:
+        """Drop this THREAD's kept-alive connections (worker teardown
+        hygiene; other threads' pools drain when their thread dies)."""
+        pool = getattr(self._local, "pool", None) or {}
+        for conn in pool.values():
+            _close_quietly(conn)
+        pool.clear()
 
     # ------------------------------------------------------- routing
 
@@ -189,24 +304,52 @@ class ServiceClient:
 
     def _call_once(self, method: str, path: str,
                    body: Optional[dict] = None,
-                   netloc: Optional[str] = None) -> dict:
-        conn = HTTPConnection(netloc or self.netloc, timeout=self.timeout)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = json.loads(resp.read() or b"{}")
-        finally:
-            conn.close()
-        if resp.status >= 400:
-            raise ServiceError(resp.status, data)
-        return data
+                   netloc: Optional[str] = None,
+                   raw: Optional[bytes] = None,
+                   content_type: Optional[str] = None) -> dict:
+        netloc = netloc or self.netloc
+        if raw is not None:
+            payload: Optional[bytes] = raw
+            headers = {"Content-Type":
+                       content_type or FRAME_CONTENT_TYPE}
+        elif body is not None:
+            payload = json.dumps(body).encode()
+            headers = {"Content-Type": "application/json"}
+        else:
+            payload, headers = None, {}
+        for fresh in (False, True):
+            conn, reused = self._checkout(netloc, force_fresh=fresh)
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = json.loads(resp.read() or b"{}")
+            except RETRYABLE_CONN_ERRORS:
+                _close_quietly(conn)
+                if reused and not fresh:
+                    # A REUSED socket died mid-call: the classic stale
+                    # keep-alive race (daemon restarted / idle-closed
+                    # between calls). One immediate fresh-connection
+                    # retry, NOT charged to the caller's attempt budget
+                    # — this failure mode is an artifact of reuse, and
+                    # without this the keep-alive client would be
+                    # strictly less robust than connection-per-call.
+                    continue
+                raise
+            if resp.will_close or not client_keepalive():
+                _close_quietly(conn)
+            else:
+                self._checkin(netloc, conn)
+            if resp.status >= 400:
+                raise ServiceError(resp.status, data)
+            return data
+        raise AssertionError("unreachable")  # loop returns or raises
 
     def _call(self, method: str, path: str, body: Optional[dict] = None,
               retry: bool = True, affinity: Optional[str] = None,
               failover_404: bool = False,
-              prefer: Optional[str] = None) -> dict:
+              prefer: Optional[str] = None,
+              raw: Optional[bytes] = None,
+              content_type: Optional[str] = None) -> dict:
         """One logical call with the retry discipline (module
         docstring). `retry=False` restores single-shot semantics for
         calls the caller wants to fail fast. The attempt cap is
@@ -222,8 +365,13 @@ class ServiceClient:
         while attempt < attempts:
             attempt += 1
             netloc = route[ri % len(route)]
+            # binary-frame kwargs only when in play: JSON calls keep the
+            # historical _call_once shape (test transports stub it)
+            extra = ({"raw": raw, "content_type": content_type}
+                     if raw is not None or content_type is not None else {})
             try:
-                out = self._call_once(method, path, body, netloc=netloc)
+                out = self._call_once(method, path, body, netloc=netloc,
+                                      **extra)
                 self._penalty_until.pop(netloc, None)
                 self._answered_by = netloc
                 return out
@@ -282,7 +430,7 @@ class ServiceClient:
                algorithm: str = "auto", deadline_ms: Optional[float] = None,
                priority: int = 0, retry: bool = True,
                consistency: str = "linearizable",
-               affinity: bool = True) -> dict:
+               affinity: bool = True, binary: bool = False) -> dict:
         """Submit histories (History objects or op-dict lists); returns
         the daemon's request record ({"id", "status", ...}). Retries
         429/503/connection failures with capped jittered backoff up to
@@ -290,7 +438,15 @@ class ServiceClient:
         failure raises ServiceError (read `.retry_after_s`) or the
         connection error. `retry=False` fails fast. `consistency`
         selects the verdict's ladder rung (linearizable / sequential /
-        session)."""
+        session). `binary=True` encodes CLIENT-SIDE and ships one
+        columnar frame (ISSUE 18) — same verdict, same idempotency
+        (the server re-derives the fingerprint over the same bytes the
+        JSON path would have encoded to)."""
+        if binary:
+            return self._submit_binary(
+                histories, workload=workload, algorithm=algorithm,
+                deadline_ms=deadline_ms, priority=priority, retry=retry,
+                consistency=consistency, affinity=affinity)
         rows = [h.to_dicts() if hasattr(h, "to_dicts") else list(h)
                 for h in histories]
         key = None
@@ -311,6 +467,36 @@ class ServiceClient:
             "algorithm": algorithm, "deadline_ms": deadline_ms,
             "priority": priority, "consistency": consistency},
             retry=retry, affinity=key)
+        self._remember_owner(rec.get("id"))
+        return rec
+
+    def _submit_binary(self, histories: Sequence, workload: str,
+                       algorithm: str, deadline_ms: Optional[float],
+                       priority: int, retry: bool, consistency: str,
+                       affinity: bool) -> dict:
+        """Client-side encode + one columnar frame (ISSUE 18 tentpole):
+        the SAME `build_units` + `encode_history` the server's JSON
+        path runs, executed here — so the server-derived fingerprint
+        over the shipped tensors is byte-identical to the JSON path's,
+        and the locally computed digest doubles as the rendezvous
+        affinity key (replica cache locality for free). The frame is
+        built ONCE; every retry re-sends identical bytes."""
+        from ..checker.consistency import normalize_consistency
+        from .frame import encode_submit_frame
+        from .request import build_units, fingerprint_encodings
+
+        from ..history.packing import encode_history
+
+        consistency = normalize_consistency(consistency)
+        model, units = build_units(histories, workload)
+        encs = [encode_history(h, model) for _, h in units]
+        fp = fingerprint_encodings(model, algorithm, encs, consistency)
+        frame = encode_submit_frame(
+            workload, algorithm, consistency,
+            [label for label, _ in units], encs,
+            deadline_ms=deadline_ms, priority=priority, fingerprint=fp)
+        rec = self._call("POST", "/submit", retry=retry,
+                         affinity=fp if affinity else None, raw=frame)
         self._remember_owner(rec.get("id"))
         return rec
 
@@ -352,13 +538,17 @@ class ServiceClient:
                algorithm: str = "auto",
                consistency: str = "linearizable",
                session_id: Optional[str] = None,
-               resume: bool = False) -> "StreamSession":
+               resume: bool = False,
+               binary: bool = False) -> "StreamSession":
         """Open (or resume) a streaming verdict session (ISSUE 12);
         returns a `StreamSession` whose `append`/`finish` carry the
-        per-segment idempotent retry discipline."""
+        per-segment idempotent retry discipline. `binary=True` runs
+        the incremental encoder CLIENT-side and ships each settled
+        suffix as a columnar frame (ISSUE 18)."""
         s = StreamSession(self, workload=workload, units=units,
                           algorithm=algorithm, consistency=consistency,
-                          session_id=session_id, resume=resume)
+                          session_id=session_id, resume=resume,
+                          binary=binary)
         s.open()
         return s
 
@@ -410,7 +600,7 @@ class StreamSession:
                  units: int = 1, algorithm: str = "auto",
                  consistency: str = "linearizable",
                  session_id: Optional[str] = None,
-                 resume: bool = False):
+                 resume: bool = False, binary: bool = False):
         self.client = client
         self.workload = workload
         self.units = units
@@ -418,6 +608,24 @@ class StreamSession:
         self.consistency = consistency
         self.session_id = session_id
         self.resume = resume
+        #: binary lane (ISSUE 18): the incremental encoder runs HERE;
+        #: each append ships the settled suffix as a columnar frame.
+        #: Incompatible with `resume`: the encoder carry lives in this
+        #: process, so a crashed binary producer cannot continue its
+        #: old session (the JSON lane, whose encoder lives server-side,
+        #: can) — it must open a fresh session instead.
+        self.binary = binary
+        if binary and resume:
+            raise ValueError(
+                "binary streams cannot resume: the client-side encoder "
+                "carry died with the old producer; open a fresh "
+                "session (or use the JSON lane, which resumes)")
+        self._encoders: Optional[list] = None
+        #: (seq, frame) whose send failed: re-sent (digest-idempotent)
+        #: before the next append/finish, so a transport blip never
+        #: desyncs the client encoder from the server's counters.
+        self._pending_frame: Optional[tuple] = None
+        self._finalized = False
         self.seq = 1
         self.last_state: Optional[dict] = None
 
@@ -433,6 +641,16 @@ class StreamSession:
         self.session_id = rec["session"]
         self.seq = int(rec.get("next_seq", 1))
         self.last_state = rec
+        if self.binary and self._encoders is None:
+            from ..history.packing import IncrementalEncoder
+            from .request import service_workloads
+
+            # the same model the server instantiated at open — the
+            # client-side encoder must emit the stream the server-side
+            # one would have (service_workloads is the shared registry)
+            factory, _ = service_workloads()[self.workload]
+            self._encoders = [IncrementalEncoder(factory())
+                              for _ in range(int(self.units))]
         return rec
 
     @staticmethod
@@ -447,6 +665,8 @@ class StreamSession:
         or one list per unit). Assigns the next seq; safe to call again
         after any transport failure — the seq/digest pair makes the
         resend idempotent."""
+        if self.binary:
+            return self._append_binary(ops)
         if ops and not isinstance(ops[0], (list, tuple)) \
                 or hasattr(ops, "to_dicts"):
             payload = self._rows(ops)
@@ -467,6 +687,82 @@ class StreamSession:
         self.last_state = rec
         return rec
 
+    # ----------------------------------------------------- binary lane
+
+    def _parse_unit_ops(self, ops) -> list:
+        """Wire-shape normalization for the binary lane, mirroring the
+        server's `_parse_units` rules (flat list for single-unit
+        sessions, one list per unit otherwise; nemesis rows filtered;
+        list values retupled) — the client-side encoder must see
+        exactly the rows the server-side one would have."""
+        from ..history.ops import NEMESIS, Op
+
+        if hasattr(ops, "to_dicts") or (
+                ops and not isinstance(ops[0], (list, tuple))):
+            per_unit = [list(ops)]
+        elif ops:
+            per_unit = [list(u) for u in ops]
+        else:
+            per_unit = [[] for _ in range(len(self._encoders))]
+        if len(per_unit) != len(self._encoders):
+            raise ValueError(
+                f"segment carries {len(per_unit)} unit list(s); session "
+                f"has {len(self._encoders)} unit(s)")
+        parsed = []
+        for rows in per_unit:
+            out = []
+            for d in rows:
+                op = d if isinstance(d, Op) else Op.from_dict(dict(d))
+                if isinstance(op.value, list):
+                    op.value = tuple(op.value)
+                if op.process != NEMESIS:
+                    out.append(op)
+            parsed.append(out)
+        return parsed
+
+    def _binary_payload(self, parsed, final: bool) -> list:
+        units = []
+        for encd, rows in zip(self._encoders, parsed):
+            ev, oi, pr = encd.feed(rows, final=final)
+            units.append({"events": ev, "op_index": oi, "proc": pr,
+                          "n_slots": encd.n_slots, "n_ops": encd.n_ops,
+                          "consumed": encd.consumed, "final": final})
+        return units
+
+    def _send_frame(self, seq: int, frame: bytes) -> dict:
+        rec = self.client._call("POST", "/stream/append", raw=frame)
+        self.seq = seq + 1
+        self.last_state = rec
+        return rec
+
+    def _flush_pending(self) -> None:
+        """Re-send a frame whose first send failed (digest-idempotent:
+        identical bytes under the same seq). Without this a transport
+        blip would desync the client encoder — which already consumed
+        the ops — from the server's counters."""
+        if self._pending_frame is None:
+            return
+        seq, frame = self._pending_frame
+        self._send_frame(seq, frame)
+        self._pending_frame = None
+
+    def _append_binary(self, ops, final: bool = False) -> Optional[dict]:
+        from .frame import encode_segment_frame
+
+        self._flush_pending()
+        parsed = self._parse_unit_ops(ops)
+        # an empty final flush still ships: the segment carries the
+        # final flag (and any end-of-history settle events)
+        units = self._binary_payload(parsed, final=final)
+        seq = self.seq
+        frame = encode_segment_frame(self.session_id, seq, units)
+        self._pending_frame = (seq, frame)
+        rec = self._send_frame(seq, frame)
+        self._pending_frame = None
+        return rec
+
+    # --------------------------------------------------------- surface
+
     def status(self) -> dict:
         rec = self.client._call(
             "GET", f"/stream/status?session={self.session_id}")
@@ -474,6 +770,14 @@ class StreamSession:
         return rec
 
     def finish(self) -> dict:
+        if self.binary and not self._finalized:
+            # the server REFUSES a binary finish without the final
+            # flush (crashed-pair OPENs are linearization candidates);
+            # send it exactly once — empty ops, final=true.
+            self._append_binary([], final=True)
+            self._finalized = True
+        elif self.binary:
+            self._flush_pending()
         rec = self.client._call("POST", "/stream/finish",
                                 {"session": self.session_id})
         self.last_state = rec

@@ -864,6 +864,18 @@ class CheckingService:
                     consistency=consistency)
         return self._admit(req)
 
+    def submit_frame(self, payload) -> CheckRequest:
+        """Admit a binary columnar submission frame (service/frame.py,
+        ISSUE 18): the client ran `encode_history` locally, so
+        admission decodes zero-copy tensor views, re-derives the
+        fingerprint over the received bytes, and skips the encode. The
+        WAL already persists ENCODINGS (journal.encode_submit), so the
+        frame journals without any re-encode either. Error taxonomy
+        matches `submit` (FrameError is a ValueError → 400)."""
+        from .admission import admit_frame
+
+        return self._admit(admit_frame(payload))
+
     def submit_run_dir(self, run_dir, algorithm: str = "auto",
                        deadline_ms: Optional[float] = None,
                        priority: int = 0,

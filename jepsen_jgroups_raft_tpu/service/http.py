@@ -35,18 +35,38 @@ Streaming sessions (ISSUE 12)::
     POST /stream/finish  {"session"}   → 200 final record (idempotent)
     GET  /stream/status?session=ID     → 200 session state
 
+Binary ingest lane (ISSUE 18): ``POST /submit`` and ``POST
+/stream/append`` additionally accept ``Content-Type:
+application/x-jgraft-frame`` bodies — the length-delimited columnar
+frames of `service/frame.py`, carrying CLIENT-encoded int32 tensors
+that admission memoryview-slices zero-copy into the fingerprint path
+(no JSON parse, no server-side encode). Malformed frames are 400s via
+the same taxonomy as malformed JSON. The JSON surface is unchanged
+byte for byte.
+
+Same-host lane (ISSUE 18): `make_uds_server`/`serve_uds_in_thread`
+bind the SAME handler over an AF_UNIX socket — no TCP stack, no
+loopback port, one less copy per request.  ``JGRAFT_SERVICE_UDS=
+/path.sock`` makes `serve_checker` listen on both.
+
 Run it: ``python -m jepsen_jgroups_raft_tpu serve-checker`` (cli.py) or
 embed via `make_server` (tests, the bench's --service mode).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
+import socket
+import socketserver
+import stat
 import threading
 from functools import partial
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from ..platform import env_str
 from .admission import QueueFull
 from .daemon import CheckingService, ServiceStopped
 from .stream import StreamBusy, StreamConflict
@@ -54,6 +74,9 @@ from .stream import StreamBusy, StreamConflict
 #: Submission body size cap (bytes): 64 MiB of JSON ops is far beyond
 #: any legitimate history batch and bounds admission-side memory.
 MAX_BODY_BYTES = 64 << 20
+
+#: Content-Type of the binary columnar frames (service/frame.py).
+FRAME_CONTENT_TYPE = "application/x-jgraft-frame"
 
 #: Cap on blocking result waits (seconds) so a handler thread can never
 #: be parked indefinitely by one client.
@@ -87,12 +110,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _body(self) -> dict:
+    def _raw_body(self) -> bytes:
         length = int(self.headers.get("Content-Length") or 0)
         if length > MAX_BODY_BYTES:
             raise ValueError(f"body too large ({length} bytes)")
-        raw = self.rfile.read(length) if length else b"{}"
-        payload = json.loads(raw or b"{}")
+        return self.rfile.read(length) if length else b""
+
+    def _body(self) -> dict:
+        raw = self._raw_body() or b"{}"
+        payload = json.loads(raw)
         if not isinstance(payload, dict):
             raise ValueError("request body must be a JSON object")
         return payload
@@ -146,6 +172,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path, _ = self._query()
+        ctype = (self.headers.get("Content-Type")
+                 or "").split(";")[0].strip().lower()
+        if ctype == FRAME_CONTENT_TYPE:
+            self._post_frame(path)
+            return
         try:
             body = self._body()
         except (ValueError, json.JSONDecodeError) as e:
@@ -254,6 +285,76 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, req.to_dict(include_results=req.cached))
 
+    def _post_frame(self, path: str) -> None:
+        """Binary-frame POSTs (ISSUE 18). The error taxonomy MIRRORS
+        the JSON handlers above per endpoint — `frame.FrameError` is a
+        ValueError, so a torn/corrupt frame lands in the same 400 arm
+        a malformed JSON body does; a client cannot tell the lanes
+        apart by failure shape."""
+        try:
+            raw = self._raw_body()
+        except ValueError as e:
+            self._send(400, {"error": f"bad request body: {e}"})
+            return
+        if path == "/submit":
+            try:
+                req = self.service.submit_frame(raw)
+            except QueueFull as e:
+                self._send(429, {"error": str(e),
+                                 "retry_after_s": e.retry_after_s},
+                           {"Retry-After":
+                            str(max(1, int(e.retry_after_s)))})
+                return
+            except ServiceStopped as e:
+                self._send(503, {"error": str(e),
+                                 "retry_after_s": STOPPED_RETRY_AFTER_S},
+                           {"Retry-After":
+                            str(max(1, int(STOPPED_RETRY_AFTER_S)))})
+                return
+            except (ValueError, OSError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, req.to_dict(include_results=req.cached))
+            return
+        if path == "/stream/append":
+            from .frame import FrameError, SegmentFrame, decode_frame
+
+            try:
+                fr = decode_frame(raw)
+                if not isinstance(fr, SegmentFrame):
+                    raise FrameError("expected a stream-segment frame "
+                                     "on /stream/append")
+                # idempotency digest over the RAW frame bytes: a
+                # retrying client re-sends the identical frame (the
+                # encoder is deterministic), so a post-crash duplicate
+                # compares equal — the binary twin of segment_digest.
+                out = self.service.streams.append_binary(
+                    fr.session, fr.seq, fr.units, n_bytes=len(raw),
+                    digest=hashlib.sha256(raw).hexdigest())
+            except KeyError as e:
+                self._send(404, {"error": f"unknown stream session "
+                                          f"{e.args[0]!r}"})
+                return
+            except StreamBusy as e:
+                self._send(429, {"error": str(e),
+                                 "retry_after_s": e.retry_after_s},
+                           {"Retry-After":
+                            str(max(1, int(e.retry_after_s)))})
+                return
+            except StreamConflict as e:
+                payload = {"error": str(e)}
+                if e.expected_seq is not None:
+                    payload["expected_seq"] = e.expected_seq
+                self._send(409, payload)
+                return
+            except (ValueError, TypeError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, out)
+            return
+        self._send(404, {"error": f"endpoint {path!r} does not accept "
+                                  "binary frames"})
+
 
 def make_server(service: CheckingService, host: str = "127.0.0.1",
                 port: int = 0) -> Tuple[ThreadingHTTPServer, int]:
@@ -262,6 +363,51 @@ def make_server(service: CheckingService, host: str = "127.0.0.1",
     httpd = ThreadingHTTPServer((host, port),
                                 partial(_Handler, service=service))
     return httpd, httpd.server_address[1]
+
+
+class _UnixHTTPServer(ThreadingHTTPServer):
+    """The same threading HTTP front over an AF_UNIX socket (ISSUE 18
+    same-host lane): identical handlers and taxonomy, no TCP stack or
+    loopback port between a co-located producer and the daemon.
+    `server_bind` skips the TCP-specific getfqdn/port derivation (an
+    AF_UNIX address is a filesystem path) and clears a STALE socket
+    file first — the normal residue of a SIGKILL'd daemon; refusing to
+    bind over it would turn every crash into a manual cleanup."""
+
+    address_family = socket.AF_UNIX
+
+    def server_bind(self):
+        path = self.server_address
+        try:
+            if stat.S_ISSOCK(os.stat(path).st_mode):
+                os.unlink(path)
+        except FileNotFoundError:
+            pass
+        # NOT os.unlink unconditionally: a regular file at the path is
+        # someone else's data — fail loudly instead of deleting it.
+        socketserver.TCPServer.server_bind(self)
+        self.server_name = "localhost"
+        self.server_port = 0
+
+
+def make_uds_server(service: CheckingService, path) -> _UnixHTTPServer:
+    """Bind the service's unix-domain-socket front at `path`; the
+    caller owns `serve_forever` and unlinking the socket after
+    `server_close`."""
+    return _UnixHTTPServer(str(path), partial(_Handler, service=service))
+
+
+def serve_uds_in_thread(service: CheckingService, path):
+    """Start the AF_UNIX front on a daemon thread; returns (httpd,
+    thread). Shut down with `httpd.shutdown(); httpd.server_close()`
+    (the socket file is unlinked by `server_close` callers — see
+    `serve_checker` — or left for the next bind's stale-socket
+    cleanup)."""
+    httpd = make_uds_server(service, path)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="graftd-uds")
+    t.start()
+    return httpd, t
 
 
 def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
@@ -288,11 +434,16 @@ def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
         # fleets set the env to the host's routable address.
         reach = "127.0.0.1" if host in ("0.0.0.0", "::") else host
         service.cluster.set_url(f"http://{reach}:{bound}")
+    uds_path = env_str("JGRAFT_SERVICE_UDS", "").strip()
+    uds_httpd = None
+    if uds_path:
+        uds_httpd, _uds_thread = serve_uds_in_thread(service, uds_path)
     recovered = service.stats()["recovered_requests"]
     print(f"graftd: checking service on http://{host}:{bound}/ "
           f"(queue={service.queue.capacity}, "
           f"workers={service.n_workers}, store={store_root}, "
           f"journal={'on' if service._journal is not None else 'off'}"
+          + (f", uds={uds_path}" if uds_path else "")
           + (f", cluster={service.cluster.replica_id}"
              if service.cluster is not None else "")
           + (f", recovered={recovered}" if recovered else "") + ")")
@@ -302,6 +453,13 @@ def serve_checker(store_root: str = "store", host: str = "0.0.0.0",
         pass
     finally:
         httpd.server_close()
+        if uds_httpd is not None:
+            uds_httpd.shutdown()
+            uds_httpd.server_close()
+            try:
+                os.unlink(uds_path)
+            except OSError:
+                pass  # already gone / replaced by a newer bind
         service.shutdown(wait=True)
     return 0
 

@@ -77,10 +77,14 @@ JOURNAL_VERSION = 1
 #: replaying every request record, and a pre-PR-12 WAL (no stream
 #: records at all) replays byte-for-byte as before (the forward-compat
 #: fixture test in tests/test_stream.py pins both directions).
-STREAM_VERSION = 1
+#: v2 (ISSUE 18): adds the ``stream-bseg`` kind — binary-lane segments
+#: journal the client-settled ARRAYS instead of raw op dicts. An old
+#: daemon skips v2 records loudly (fail-safe: a WAL holding binary
+#: segments is not replayable by a daemon that cannot decode them).
+STREAM_VERSION = 2
 
 #: The stream record kinds (`kind` field values).
-STREAM_KINDS = ("stream-open", "stream-seg", "stream-fin")
+STREAM_KINDS = ("stream-open", "stream-seg", "stream-bseg", "stream-fin")
 
 #: Appends timed for the bench's admission-overhead evidence
 #: (`journal_append_p50_ms` in `bench.py --service` rows).
@@ -266,6 +270,55 @@ def encode_stream_segment(sid: str, seq: int, unit_ops, digest: str) -> dict:
         "digest": digest,
         "ops": unit_ops,
     }
+
+
+def encode_stream_bseg(sid: str, seq: int, units, digest: str) -> dict:
+    """One binary-lane segment (ISSUE 18): the client-settled suffix
+    ARRAYS per unit plus the client encoder's cumulative counters —
+    there are no raw op dicts to journal on this lane, and replay feeds
+    the arrays straight back (`StreamSession.append_binary`) instead of
+    re-encoding. Unit dicts are the `frame.SegmentFrame` payload shape:
+    ``{"events", "op_index", "proc" (array|None), "n_slots", "n_ops",
+    "consumed", "final"}``."""
+    return {
+        "kind": "stream-bseg",
+        "v": JOURNAL_VERSION,
+        "stream_v": STREAM_VERSION,
+        "sid": sid,
+        "seq": int(seq),
+        "digest": digest,
+        "units": [{
+            "n_events": int(np.asarray(u["events"]).reshape(-1, 5).shape[0]),
+            "n_slots": int(u["n_slots"]),
+            "n_ops": int(u["n_ops"]),
+            "consumed": int(u["consumed"]),
+            "final": bool(u.get("final", False)),
+            "events": _b64(np.asarray(u["events"]).reshape(-1, 5)),
+            "op_index": _b64(u["op_index"]),
+            **({"proc": _b64(u["proc"])}
+               if u.get("proc") is not None else {}),
+        } for u in units],
+    }
+
+
+def decode_stream_bseg_units(rec: dict) -> List[dict]:
+    """Rebuild a ``stream-bseg`` record's per-unit payload dicts (the
+    same shape `append_binary` consumes live). Malformed payloads raise
+    ValueError/KeyError — the caller (session rebuild) skips loudly."""
+    out: List[dict] = []
+    for u in rec["units"]:
+        n = int(u["n_events"])
+        out.append({
+            "events": _unb64(u["events"], (n, 5)),
+            "op_index": _unb64(u["op_index"], (n,)),
+            "proc": (_unb64(u["proc"], (n,))
+                     if u.get("proc") is not None else None),
+            "n_slots": int(u["n_slots"]),
+            "n_ops": int(u["n_ops"]),
+            "consumed": int(u["consumed"]),
+            "final": bool(u.get("final", False)),
+        })
+    return out
 
 
 def encode_stream_fin(sid: str, status: str, results=None,
@@ -639,7 +692,8 @@ class AdmissionJournal:
                     sid = str(rec.get("sid"))
                     if sid not in stream_fins:
                         keep.append(rec)      # unfinished: keep whole
-                    elif kind != "stream-seg" and sid not in drop_fins:
+                    elif (kind not in ("stream-seg", "stream-bseg")
+                          and sid not in drop_fins):
                         keep.append(rec)      # finished: open+fin only
                     continue
                 if kind != "submit":

@@ -241,19 +241,14 @@ class CheckRequest:
         return d
 
 
-def admit(histories: Sequence, workload: str, algorithm: str = "auto",
-          deadline_ms: Optional[float] = None, priority: int = 0,
-          default_deadline_s: float = 3600.0,
-          request_id: Optional[str] = None,
-          consistency: str = "linearizable") -> CheckRequest:
-    """Normalize a submission into a CheckRequest (encode once +
-    fingerprint). `histories` items are History objects or op-dict
-    lists. Raises ValueError on unknown workloads / malformed ops /
-    unknown consistency rungs — the HTTP surface maps that to 400,
-    never into the queue."""
-    from ..checker.consistency import normalize_consistency
-
-    consistency = normalize_consistency(consistency)
+def build_units(histories: Sequence, workload: str):
+    """Normalize raw submission material into (model, units): the
+    workload's model instance plus (label, History) pairs — one
+    frontier-check unit each, independent workloads split per key.
+    ONE home for the unit decomposition, shared by server-side `admit`
+    and the binary lane's CLIENT-side encoder (ISSUE 18): both sides
+    must derive identical unit lists from identical histories, or the
+    server-derived fingerprint would diverge from the JSON path's."""
     workloads = service_workloads()
     if workload not in workloads:
         raise ValueError(f"unknown workload {workload!r} "
@@ -275,6 +270,23 @@ def admit(histories: Sequence, workload: str, algorithm: str = "auto",
             units.append((f"h{i}", h))
     if not units:
         raise ValueError("empty submission: no checkable history units")
+    return model, units
+
+
+def admit(histories: Sequence, workload: str, algorithm: str = "auto",
+          deadline_ms: Optional[float] = None, priority: int = 0,
+          default_deadline_s: float = 3600.0,
+          request_id: Optional[str] = None,
+          consistency: str = "linearizable") -> CheckRequest:
+    """Normalize a submission into a CheckRequest (encode once +
+    fingerprint). `histories` items are History objects or op-dict
+    lists. Raises ValueError on unknown workloads / malformed ops /
+    unknown consistency rungs — the HTTP surface maps that to 400,
+    never into the queue."""
+    from ..checker.consistency import normalize_consistency
+
+    consistency = normalize_consistency(consistency)
+    model, units = build_units(histories, workload)
     encs = [encode_history(h, model) for _, h in units]
     now = time.monotonic()
     deadline = now + (deadline_ms / 1000.0 if deadline_ms is not None
@@ -293,6 +305,68 @@ def admit(histories: Sequence, workload: str, algorithm: str = "auto",
         priority=clamp_priority(priority),
         consistency=consistency,
     )
+
+
+def admit_encoded(workload: str, labels: Sequence[str],
+                  encs: Sequence[EncodedHistory],
+                  algorithm: str = "auto",
+                  deadline_ms: Optional[float] = None, priority: int = 0,
+                  default_deadline_s: float = 3600.0,
+                  consistency: str = "linearizable",
+                  claimed_fingerprint: Optional[str] = None) -> CheckRequest:
+    """Admit a CLIENT-encoded submission (the binary frame lane, ISSUE
+    18): the per-unit encodings arrive already packed, so admission
+    skips the encode entirely — but NEVER the fingerprint. The digest
+    is re-derived here over the received tensor bytes, exactly the
+    computation the JSON path runs on its own encode output, so a
+    client lying about its payload (or its claimed fingerprint) can
+    only corrupt its own verdict: every cache/store/WAL key is the
+    server-derived value (doc/checker-design.md §20). A claimed
+    fingerprint that disagrees is recorded in the request's stats
+    (operators can alarm on it) and otherwise ignored.
+
+    Like journal replay (`journal.decode_request`), the units carry
+    empty History placeholders — raw ops stay client-side by design,
+    so the trace record has no history.jsonl and counterexample
+    minimization is skipped for frame submissions."""
+    from ..checker.consistency import normalize_consistency
+
+    consistency = normalize_consistency(consistency)
+    workloads = service_workloads()
+    if workload not in workloads:
+        raise ValueError(f"unknown workload {workload!r} "
+                         f"(have: {', '.join(sorted(workloads))})")
+    model = workloads[workload][0]()
+    if not encs:
+        raise ValueError("empty submission: no checkable history units")
+    if len(labels) != len(encs):
+        raise ValueError(f"{len(labels)} labels for {len(encs)} "
+                         "encodings")
+    fingerprint = fingerprint_encodings(model, algorithm, encs,
+                                        consistency)
+    now = time.monotonic()
+    deadline = now + (deadline_ms / 1000.0 if deadline_ms is not None
+                      else default_deadline_s)
+    req = CheckRequest(
+        id=uuid.uuid4().hex[:12],
+        workload=workload,
+        model=model,
+        algorithm=algorithm,
+        units=[(str(label), History()) for label in labels],
+        encs=list(encs),
+        fingerprint=fingerprint,
+        deadline=deadline,
+        submitted=now,
+        priority=clamp_priority(priority),
+        consistency=consistency,
+    )
+    if claimed_fingerprint is not None \
+            and claimed_fingerprint != fingerprint:
+        # keyed on the server's digest regardless; the mismatch is
+        # evidence, not an error (a 400 would let a prober distinguish
+        # digests it does not hold the preimage of)
+        req.stats["fingerprint_mismatch"] = True
+    return req
 
 
 def clamp_priority(priority) -> int:

@@ -69,7 +69,8 @@ from ..checker.schedule import CarriedScan
 from ..history.ops import NEMESIS, History, Op
 from ..history.packing import EncodedHistory, IncrementalEncoder
 from ..platform import env_float, env_int
-from .journal import (encode_stream_fin, encode_stream_open,
+from .journal import (decode_stream_bseg_units, encode_stream_bseg,
+                      encode_stream_fin, encode_stream_open,
                       encode_stream_segment)
 
 LOG = logging.getLogger("jgraft.service")
@@ -158,6 +159,28 @@ def segment_digest(unit_ops) -> str:
         unit_ops, sort_keys=True, default=str).encode()).hexdigest()
 
 
+def binary_segment_digest(units_payload) -> str:
+    """Idempotency key of a BINARY segment (ISSUE 18) when the caller
+    has no raw frame bytes to hash (direct API use, journal replay of a
+    record appended that way). The HTTP surface hashes the received
+    frame bytes instead — either way the journaled digest is what a
+    post-crash duplicate compares against, and a client retry resends
+    identical content."""
+    h = hashlib.sha256()
+    for u in units_payload:
+        for key in ("n_slots", "n_ops", "consumed"):
+            h.update(str(int(u[key])).encode())
+        h.update(b"\x01" if u.get("final") else b"\x00")
+        h.update(np.ascontiguousarray(u["events"], dtype=np.int32)
+                 .tobytes())
+        h.update(np.ascontiguousarray(u["op_index"], dtype=np.int32)
+                 .tobytes())
+        if u.get("proc") is not None:
+            h.update(np.ascontiguousarray(u["proc"], dtype=np.int32)
+                     .tobytes())
+    return h.hexdigest()
+
+
 class _TokenBucket:
     """Minimal per-session budget: `rate` tokens/s, burst = 2 s worth.
     0 rate disables."""
@@ -182,6 +205,22 @@ class _TokenBucket:
         return (n - self.level) / self.rate
 
 
+class _BinaryEnc:
+    """Counter stand-in for a binary-lane unit's `enc` slot (ISSUE 18):
+    the REAL incremental encoder runs on the CLIENT; the server's
+    decision ladder only reads the cumulative counters (``n_slots`` /
+    ``n_ops`` / ``n_events`` / ``consumed``) this mirror accumulates
+    from segment headers. Counters are folded with max() so they stay
+    monotone even against a confused client — which, like a lying
+    fingerprint claim, can only corrupt its own verdict."""
+
+    def __init__(self):
+        self.n_slots = 0
+        self.n_ops = 0
+        self.n_events = 0
+        self.consumed = 0
+
+
 class StreamUnit:
     """One streamed history row: its incremental encoder, resident
     settled stream, and whichever decision engine currently carries it
@@ -190,6 +229,12 @@ class StreamUnit:
     def __init__(self, model):
         self.model = model
         self.enc = IncrementalEncoder(model)
+        #: binary lane: the client's final flush arrived (`final=true`
+        #: segment). A binary finish REQUIRES it — without the flush
+        #: the crashed-pair OPEN events of outstanding invokes are
+        #: missing, and dropping linearization candidates can turn a
+        #: valid history into a false INVALID.
+        self.bin_final = False
         # resident settled stream (dropped on spill / decide)
         self._events: List[np.ndarray] = []
         self._op_index: List[np.ndarray] = []
@@ -289,6 +334,37 @@ class StreamUnit:
                 self._proc.append(pr)
                 self.events_resident += int(ev.shape[0])
 
+    def ingest_encoded(self, u: dict) -> None:
+        """Binary-lane twin of `ingest` (ISSUE 18): the client ran the
+        incremental encoder; this applies its already-normalized
+        settled-suffix payload (`StreamSession._parse_bseg_units`) and
+        cumulative counters. No raw rows exist server-side — `ops`
+        stays empty, so escalation and carry rebuilds use the settled
+        stream or the WAL's bseg arrays, and violations ship without a
+        minimized counterexample (the same trade journal replay makes)."""
+        if self.decided:
+            return
+        enc = self.enc   # _BinaryEnc (installed by the mode latch)
+        enc.n_slots = max(enc.n_slots, u["n_slots"])
+        enc.n_ops = max(enc.n_ops, u["n_ops"])
+        enc.consumed = max(enc.consumed, u["consumed"])
+        self.ops_total = enc.consumed
+        if u["final"]:
+            self.bin_final = True
+        ev, oi, pr = u["events"], u["op_index"], u["proc"]
+        if pr is None:
+            pr = np.zeros(int(ev.shape[0]), np.int32)
+        if ev.shape[0]:
+            enc.n_events += int(ev.shape[0])
+            self.pending.append(ev)
+            if self.greedy:
+                self.cert_queue.append(ev)
+            if not self.spilled:
+                self._events.append(ev)
+                self._op_index.append(oi)
+                self._proc.append(pr)
+                self.events_resident += int(ev.shape[0])
+
 
 #: Counterexample-minimization budget (the scheduler's bound): beyond
 #: this many raw rows the violation ships without a minimized witness.
@@ -311,6 +387,13 @@ class StreamSession:
         self.units = [StreamUnit(model) for _ in range(n_units)]
         self.lock = threading.RLock()
         self.status = OPEN  # guarded_by(lock)
+        #: transport lane, latched at the first accepted segment:
+        #: "json" (raw op dicts, server-side encoder) or "binary"
+        #: (client-encoded settled suffixes — ISSUE 18). Mixing lanes
+        #: mid-session is a 409: the two lanes journal different record
+        #: kinds and rebuild through different pipelines, and a replay
+        #: must walk exactly one of them.
+        self.mode: Optional[str] = None  # guarded_by(lock)
         self.error: Optional[str] = None
         self.final: Optional[dict] = None
         self.seq_next = 1  # guarded_by(lock)
@@ -372,6 +455,10 @@ class StreamSession:
             if self.status in (DONE, FAILED):
                 raise StreamConflict(
                     f"session {self.sid} is {self.status}")
+            if self.mode == "binary":
+                raise StreamConflict(
+                    f"session {self.sid} is on the binary lane; JSON "
+                    "appends conflict", expected_seq=self.seq_next)
             try:
                 seq = int(seq)
             except (TypeError, ValueError):
@@ -407,12 +494,117 @@ class StreamSession:
                 journal.append_stream(encode_stream_segment(
                     self.sid, seq, [[op.to_dict() for op in rows]
                                     for rows in parsed], digest))
+            self.mode = "json"
             self.seen[seq] = digest
             self.seq_next = seq + 1
             self.segments += 1
             self.bytes += int(n_bytes)
             for unit, rows in zip(self.units, parsed):
                 unit.ingest(rows)
+                self._advance(unit, seq)
+            return self._state()
+
+    def _parse_bseg_units(self, units_payload) -> List[dict]:
+        """Binary twin of `_parse_units`: normalize/validate the
+        per-unit suffix payloads (`frame.SegmentFrame` shape) WITHOUT
+        mutating any unit — a malformed payload is a clean 400, never a
+        half-ingested segment."""
+        if not isinstance(units_payload, (list, tuple)):
+            raise ValueError("binary segment units must be a list")
+        if len(units_payload) != len(self.units):
+            raise ValueError(
+                f"segment carries {len(units_payload)} unit payload(s); "
+                f"session has {len(self.units)} unit(s)")
+        out: List[dict] = []
+        for i, u in enumerate(units_payload):
+            try:
+                ev = np.ascontiguousarray(
+                    u["events"], dtype=np.int32).reshape(-1, 5)
+                n = int(ev.shape[0])
+                oi = np.ascontiguousarray(
+                    u["op_index"], dtype=np.int32).reshape(n)
+                pr = u.get("proc")
+                if pr is not None:
+                    pr = np.ascontiguousarray(
+                        pr, dtype=np.int32).reshape(n)
+                out.append({
+                    "events": ev, "op_index": oi, "proc": pr,
+                    "n_slots": int(u["n_slots"]),
+                    "n_ops": int(u["n_ops"]),
+                    "consumed": int(u["consumed"]),
+                    "final": bool(u.get("final", False)),
+                })
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError(
+                    f"binary segment unit {i} malformed: {e}") from None
+        return out
+
+    def append_binary(self, seq, units_payload, n_bytes: int,
+                      journal=None, replaying: bool = False,
+                      digest: Optional[str] = None) -> dict:
+        """Binary-lane append (ISSUE 18 tentpole (b)): the client's
+        `IncrementalEncoder` already settled this suffix; the server
+        ingests the arrays straight into the SAME decision ladder the
+        JSON lane drives — greedy certifier, carried kernel, frozen
+        verdicts — with no per-append encode. Sequencing, idempotency,
+        flow control, and journal-before-2xx are the JSON append's
+        rules verbatim; the journal record is a ``stream-bseg``
+        (arrays, not op dicts) and replay feeds it back through this
+        very method."""
+        with self.lock:
+            self.last_touch = time.monotonic()
+            if self.status == INCOMPLETE:
+                raise _Parked()
+            if self.status in (DONE, FAILED):
+                raise StreamConflict(
+                    f"session {self.sid} is {self.status}")
+            if self.mode == "json":
+                raise StreamConflict(
+                    f"session {self.sid} is on the JSON lane; binary "
+                    "appends conflict", expected_seq=self.seq_next)
+            try:
+                seq = int(seq)
+            except (TypeError, ValueError):
+                raise ValueError(f"bad segment seq {seq!r}") from None
+            if digest is None:
+                digest = binary_segment_digest(units_payload)
+            if seq in self.seen:
+                if self.seen[seq] != digest:
+                    raise StreamConflict(
+                        f"segment {seq} was already appended with a "
+                        f"different payload", expected_seq=self.seq_next)
+                return dict(self._state(), duplicate=True)
+            if seq != self.seq_next:
+                raise StreamConflict(
+                    f"out-of-order segment {seq} (expected "
+                    f"{self.seq_next})", expected_seq=self.seq_next)
+            if not replaying:
+                wait = self._seg_bucket.take(1.0)
+                if wait is None:
+                    wait = self._byte_bucket.take(float(n_bytes))
+                if wait is not None:
+                    raise StreamBusy(
+                        f"session {self.sid} over its segment budget",
+                        retry_after_s=wait)
+            parsed = self._parse_bseg_units(units_payload)
+            if self.mode is None:
+                # latch: swap every unit's encoder slot for the counter
+                # mirror (mode None ⇒ zero accepted segments, so no
+                # encoder state is lost)
+                self.mode = "binary"
+                for unit in self.units:
+                    unit.enc = _BinaryEnc()
+            if journal is not None and not replaying:
+                # Durability point: fsync'd before the 2xx, same as the
+                # JSON lane.
+                journal.append_stream(encode_stream_bseg(
+                    self.sid, seq, parsed, digest))
+            self.seen[seq] = digest
+            self.seq_next = seq + 1
+            self.segments += 1
+            self.bytes += int(n_bytes)
+            for unit, u in zip(self.units, parsed):
+                unit.ingest_encoded(u)
                 self._advance(unit, seq)
             return self._state()
 
@@ -582,6 +774,21 @@ class StreamSession:
                 raise _Parked()   # raced the reaper; manager revives
             if self.final is not None:
                 return self.final   # idempotent
+            if self.mode == "binary":
+                # Soundness gate: the end-of-history settle ran on the
+                # CLIENT. Without its final-flagged flush the crashed-
+                # pair OPEN events of outstanding invokes never arrived
+                # — and those are linearization candidates whose
+                # absence can turn a valid history into a false
+                # INVALID. Refuse rather than guess.
+                missing = [i for i, u in enumerate(self.units)
+                           if not u.decided and not u.bin_final]
+                if missing:
+                    raise StreamConflict(
+                        f"binary session {self.sid}: unit(s) {missing} "
+                        "have no final-flagged flush segment; append "
+                        "one (final=true) before finish",
+                        expected_seq=self.seq_next)
             results = []
             for unit in self.units:
                 results.append(self._finish_unit(unit))
@@ -605,12 +812,14 @@ class StreamSession:
                 unit.free()
             return self.final
 
-    def _finish_unit(self, unit: StreamUnit) -> dict:
+    def _finish_unit(self, unit: StreamUnit) -> dict:  # requires(lock)
         if unit.decided:
             return unit.result
         # flush: outstanding invokes become crashed pairs (pair_ops'
-        # end-of-history rule) and every remaining event settles
-        if unit.enc is not None:
+        # end-of-history rule) and every remaining event settles. On
+        # the binary lane the CLIENT ran this flush (finish() enforced
+        # that its final-flagged segment arrived).
+        if unit.enc is not None and self.mode != "binary":
             unit.ingest([], final=True)
         if unit.greedy and not unit.spilled \
                 and unit.enc.n_events <= greedy_max_events():
@@ -662,13 +871,18 @@ class StreamSession:
 
         ops = (list(unit.ops) if unit.ops
                and len(unit.ops) == unit.ops_total else None)
-        if ops is None:
+        if ops is None and self.mode != "binary":
             ops = self.manager._replay_ops(self, unit)
         if ops is not None:
             enc = encode_history(ops, self.model)
+        elif not unit.spilled:
+            # binary lane lands here by construction (no raw ops exist
+            # server-side): the settled stream IS the complete encoding
+            # — the client's final flush settled every event.
+            enc = unit.settled_encoding()
         else:
-            enc = (unit.settled_encoding() if not unit.spilled
-                   else None)
+            enc = (self.manager._journaled_binary_encoding(self, unit)
+                   if self.mode == "binary" else None)
         if enc is None:
             return {"valid?": None, "algorithm": "stream",
                     "error": "stream not reconstructable from journal"}
@@ -712,6 +926,8 @@ class StreamSession:
             "unit_states": [self._unit_state(i, u)
                             for i, u in enumerate(self.units)],
         }
+        if self.mode is not None:
+            d["mode"] = self.mode
         if violations:
             d["violation"] = violations[0]
             d["valid?"] = INVALID
@@ -771,6 +987,7 @@ class StreamManager:
             "stream_sessions": 0,      # opened (lifetime)
             "segments_total": 0,
             "resumed_sessions": 0,
+            "binary_segments": 0,
             "stream_violations": 0,
             "stream_rejected": 0,
             "stream_idle_parked": 0,
@@ -937,6 +1154,27 @@ class StreamManager:
         self._note_rows()
         return out
 
+    def append_binary(self, sid: str, seq, units_payload, n_bytes: int,
+                      digest: Optional[str] = None) -> dict:
+        """Binary-lane append surface (ISSUE 18): same park-race retry
+        as `append`. `digest` is the HTTP layer's hash of the raw frame
+        bytes (None → content hash of the arrays)."""
+        sid = str(sid)
+        for _attempt in range(2):
+            sess = self._touch(sid)
+            try:
+                out = sess.append_binary(seq, units_payload, n_bytes,
+                                         journal=self._journal,
+                                         digest=digest)
+                break
+            except _Parked:
+                continue
+        else:
+            raise StreamConflict(f"session {sid} is parked")
+        self._count("segments_total", "binary_segments")
+        self._note_rows()
+        return out
+
     def status(self, sid: str) -> dict:
         return self._get(str(sid)).state()
 
@@ -1035,10 +1273,16 @@ class StreamManager:
         try:
             for seg in recs["segments"]:
                 try:
-                    sess.append(seg["seq"], seg["ops"], n_bytes=0,
-                                replaying=True,
-                                digest=seg.get("digest"))
-                except (ValueError, StreamConflict) as e:
+                    if seg.get("kind") == "stream-bseg":
+                        sess.append_binary(
+                            seg["seq"], decode_stream_bseg_units(seg),
+                            n_bytes=0, replaying=True,
+                            digest=seg.get("digest"))
+                    else:
+                        sess.append(seg["seq"], seg["ops"], n_bytes=0,
+                                    replaying=True,
+                                    digest=seg.get("digest"))
+                except (ValueError, KeyError, StreamConflict) as e:
                     # deterministic re-raise of a rejected segment: the
                     # live path already answered the client; skip loudly
                     LOG.warning("stream %s: journaled segment %s "
@@ -1062,6 +1306,8 @@ class StreamManager:
         idx = sess.units.index(unit)
         out = []
         for seg in recs["segments"]:
+            if seg.get("kind") == "stream-bseg":
+                return None   # binary lane: no raw ops exist in the WAL
             rows = seg["ops"]
             if len(sess.units) == 1 and (not rows or
                                          isinstance(rows[0], dict)):
@@ -1075,6 +1321,56 @@ class StreamManager:
             out.append(ops)
         return out
 
+    def _journaled_binary_units(self, sess: StreamSession,
+                                unit: StreamUnit) -> Optional[list]:
+        """Per-segment binary payload dicts of ONE unit, re-read from
+        the WAL's ``stream-bseg`` records (the binary lane's twin of
+        `_journaled_unit_ops`). None when the WAL cannot answer."""
+        if self._journal is None:
+            return None
+        recs = self._journal.stream_records(sess.sid)
+        if recs is None:
+            return None
+        idx = sess.units.index(unit)
+        out = []
+        for seg in recs["segments"]:
+            if seg.get("kind") != "stream-bseg":
+                return None
+            try:
+                units = decode_stream_bseg_units(seg)
+                out.append(units[idx])
+            except (KeyError, IndexError, TypeError, ValueError):
+                LOG.warning("stream %s: journaled binary segment %s "
+                            "undecodable", sess.sid, seg.get("seq"),
+                            exc_info=True)
+                return None
+        return out
+
+    def _journaled_binary_encoding(
+            self, sess: StreamSession,
+            unit: StreamUnit) -> Optional[EncodedHistory]:
+        """A spilled binary unit's COMPLETE settled encoding rebuilt
+        from the WAL (finish-escalation path: the resident buffers are
+        gone and no raw ops ever existed server-side)."""
+        segs = self._journaled_binary_units(sess, unit)
+        if segs is None:
+            return None
+        ev = (np.concatenate([u["events"] for u in segs])
+              if segs else np.zeros((0, 5), np.int32))
+        oi = (np.concatenate([u["op_index"] for u in segs])
+              if segs else np.zeros((0,), np.int32))
+        pr = (np.concatenate(
+                  [u["proc"] if u["proc"] is not None
+                   else np.zeros(u["events"].shape[0], np.int32)
+                   for u in segs])
+              if segs else np.zeros((0,), np.int32))
+        return EncodedHistory(
+            events=np.ascontiguousarray(ev, dtype=np.int32),
+            op_index=oi,
+            n_slots=max((u["n_slots"] for u in segs), default=0),
+            n_ops=max((u["n_ops"] for u in segs), default=0),
+            proc=pr)
+
     def _refeed_scan(self, sess: StreamSession, unit: StreamUnit,
                      final: bool = False) -> bool:
         """Rebuild a SPILLED unit's carry from the WAL: replay the
@@ -1082,7 +1378,25 @@ class StreamManager:
         settled stream into the (fresh) carry. ``final`` applies the
         end-of-history settle too (a finish-time rebuild must see the
         crashed-pair OPENs of outstanding invokes, exactly like the
-        live encoder's final flush). True on success."""
+        live encoder's final flush). True on success.
+
+        Binary lane: the journaled arrays ARE the settled stream — no
+        scratch encoder runs, and ``final`` needs no extra settle
+        because the client's final flush is itself a journaled segment
+        (finish() refuses to run without it)."""
+        # mode read under the session lock held by the _ensure_scan
+        # caller (RLock; mode is also latched by the time a unit spills)
+        if sess.mode == "binary":  # lint: allow(unguarded)
+            bsegs = self._journaled_binary_units(sess, unit)
+            if bsegs is None:
+                return False
+            for u in bsegs:
+                ev = u["events"]
+                if ev.shape[0] and unit.scan is not None:
+                    unit.scan.feed(ev)
+                    if unit.scan.decided:
+                        return True
+            return True
         segments = self._journaled_unit_ops(sess, unit)
         if segments is None:
             return False

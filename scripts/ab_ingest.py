@@ -1,0 +1,210 @@
+"""Single-process interleaved A/B for wire-speed ingest (ISSUE-18
+acceptance measurement).
+
+The tentpole claim is about the INGEST path — client-side columnar
+encode + binary frame transport + the same-host unix-socket lane — so
+the timed waves isolate admission from checking: each wave drives a
+fresh ``CheckingService(autostart=False)`` (scheduler parked, nothing
+competes with the submitters for the CPU) behind a real HTTP listener,
+with ``queue_capacity = 2 * n_requests`` so no wave ever sees a 429.
+Every payload is unique (identical payloads would exercise idempotent
+attach, not admission). Three phases:
+
+1. **identity** — before any timing, the SAME histories go through a
+   normal (checking) daemon as JSON, as binary frames over TCP, and as
+   binary frames over the unix socket; all three must produce the same
+   fingerprint and bitwise-identical verdict results. A transport that
+   changes verdicts has no business being fast.
+2. **encoding** — JSON bodies vs binary frames, both over TCP
+   loopback, >= 16 concurrent submitters, interleaved with order
+   rotated per rep. Bar: binary >= 1.5x JSON ingest req/s OR >= 1.5x
+   lower p99 submit latency (the ISSUE-18 acceptance disjunction).
+3. **lane** — binary frames over TCP loopback vs the same frames over
+   the unix-domain socket. Bar: UDS > TCP.
+
+Verdicts are judged on the MEDIAN of >= 3 interleaved reps (ingest
+waves are N threads timeslicing one CPU — wall clocks are multi-modal
+scheduler noise; min-of-few hands the verdict to the lucky rep — the
+same mood-vs-median caveat scripts/ab_hostpath.py documents).
+
+Usage: python scripts/ab_ingest.py [--reps 3] [--requests 64]
+       [--n-histories 2] [--n-ops 200] [--clients 16]
+"""
+import argparse
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pct(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--n-histories", type=int, default=2)
+    ap.add_argument("--n-ops", type=int, default=200)
+    ap.add_argument("--clients", type=int, default=16)
+    args = ap.parse_args()
+    assert args.clients >= 16, \
+        "the ISSUE-18 bar is defined at >= 16 concurrent submitters"
+
+    import random
+    import tempfile
+
+    from jepsen_jgroups_raft_tpu.history.synth import random_valid_history
+    from jepsen_jgroups_raft_tpu.service import (CheckingService,
+                                                 ServiceClient,
+                                                 serve_in_thread)
+    from jepsen_jgroups_raft_tpu.service.http import serve_uds_in_thread
+
+    overall_ok = True
+    rng = random.Random(20260807)
+
+    def payload(i):
+        # unique per request AND per wave arm: a repeated payload would
+        # hit idempotent attach and measure the dedup index, not ingest
+        return [random_valid_history(rng, "register", n_ops=args.n_ops,
+                                     n_procs=5, crash_p=0.05,
+                                     max_crashes=3)
+                for _ in range(args.n_histories)]
+
+    # ------------------------------------------------- 1. identity
+    svc = CheckingService(store_root=None, name="ab-ingest-id")
+    httpd, port, _t = serve_in_thread(svc)
+    sock = os.path.join(tempfile.mkdtemp(prefix="ab-ingest-uds-"),
+                        "graftd.sock")
+    uds_httpd, _ut = serve_uds_in_thread(svc, sock)
+    tcp = ServiceClient(f"http://127.0.0.1:{port}", timeout=60.0)
+    uds = ServiceClient("unix:" + sock, timeout=60.0)
+    probe = payload(0)
+    recs = [tcp.submit(probe, workload="register", binary=False),
+            tcp.submit(probe, workload="register", binary=True),
+            uds.submit(probe, workload="register", binary=True)]
+    fps = {r["fingerprint"] for r in recs}
+    assert len(fps) == 1, f"fingerprints diverge across transports: {fps}"
+    results = []
+    for r in recs:
+        out = tcp.result(r["id"], wait_s=120.0)
+        while out["status"] not in ("done", "failed", "cancelled"):
+            out = tcp.result(r["id"], wait_s=120.0)
+        assert out["status"] == "done", out
+        results.append(out["results"])
+    assert results[0] == results[1] == results[2], \
+        "verdict results diverge across transports"
+    httpd.shutdown(); httpd.server_close()
+    uds_httpd.shutdown(); uds_httpd.server_close()
+    svc.shutdown(wait=True)
+    print({"phase": "identity", "fingerprint": recs[0]["fingerprint"],
+           "verdicts_identical": True,
+           "transports": ["json+tcp", "binary+tcp", "binary+uds"]})
+
+    # ------------------------------------------- timed ingest waves
+    def wave(binary: bool, lane: str):
+        """One ingest-only wave: fresh parked daemon, fresh listener,
+        args.requests unique submissions from args.clients threads.
+        Returns (wall_s, submit latencies)."""
+        service = CheckingService(store_root=None, name="ab-ingest",
+                                  cache_capacity=0,
+                                  queue_capacity=args.requests * 2,
+                                  autostart=False)
+        if lane == "uds":
+            d = tempfile.mkdtemp(prefix="ab-ingest-uds-")
+            spath = os.path.join(d, "graftd.sock")
+            srv, _th = serve_uds_in_thread(service, spath)
+            url = "unix:" + spath
+        else:
+            srv, p, _th = serve_in_thread(service)
+            url = f"http://127.0.0.1:{p}"
+        pls = [payload(i) for i in range(args.requests)]
+        idx = iter(range(args.requests))
+        lock = threading.Lock()
+        lats: list = []
+
+        def submitter():
+            cl = ServiceClient(url, timeout=60.0)
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                t0 = time.perf_counter()
+                cl.submit(pls[i], workload="register", binary=binary)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lats.append(dt)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=submitter, daemon=True)
+                   for _ in range(args.clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        srv.shutdown()
+        srv.server_close()
+        service.shutdown(wait=True)
+        assert len(lats) == args.requests
+        return wall, lats
+
+    def ab(label, arms, bar_note):
+        """Interleaved A/B over `arms` ({name: (binary, lane)}),
+        median-of-reps; returns {name: (med_wall, p99)}."""
+        names = list(arms)
+        for n in names:               # warm-up both arms, uncounted
+            wave(*arms[n])
+        walls = {n: [] for n in names}
+        p99s = {n: [] for n in names}
+        for rep in range(max(3, args.reps)):
+            order = names if rep % 2 == 0 else names[::-1]
+            for n in order:
+                w, lats = wave(*arms[n])
+                walls[n].append(w)
+                p99s[n].append(pct(lats, 0.99))
+        out = {}
+        for n in names:
+            out[n] = (statistics.median(walls[n]),
+                      statistics.median(p99s[n]))
+        print({"phase": label, "bar": bar_note,
+               "n_requests": args.requests,
+               "histories_per_request": args.n_histories,
+               "n_ops": args.n_ops, "client_concurrency": args.clients,
+               **{f"{n}_req_s": round(args.requests / out[n][0], 2)
+                  for n in names},
+               **{f"{n}_p99_s": round(out[n][1], 4) for n in names},
+               "rep_walls_s": {n: [round(t, 3) for t in walls[n]]
+                               for n in names}})
+        return out
+
+    # ------------------------------------------------- 2. encoding
+    enc = ab("encoding", {"binary": (True, "tcp"), "json": (False, "tcp")},
+             "binary >= 1.5x json req/s OR >= 1.5x lower p99 @ >=16 subs")
+    sp_req = enc["json"][0] / enc["binary"][0]
+    sp_p99 = enc["json"][1] / max(enc["binary"][1], 1e-9)
+    enc_ok = sp_req >= 1.5 or sp_p99 >= 1.5
+    print({"phase": "encoding", "req_s_speedup": round(sp_req, 3),
+           "p99_speedup": round(sp_p99, 3), "acceptance_1_5x": enc_ok})
+    overall_ok &= enc_ok
+
+    # ----------------------------------------------------- 3. lane
+    lane = ab("lane", {"uds": (True, "uds"), "tcp": (True, "tcp")},
+              "binary over UDS beats binary over TCP loopback")
+    sp_lane = lane["tcp"][0] / lane["uds"][0]
+    lane_ok = sp_lane > 1.0
+    print({"phase": "lane", "uds_speedup": round(sp_lane, 3),
+           "acceptance_uds_beats_tcp": lane_ok})
+    overall_ok &= lane_ok
+
+    print({"acceptance_all": overall_ok})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,446 @@
+"""Binary columnar frames + the wire-speed ingest lane (ISSUE 18).
+
+Codec half: round-trip fidelity across every model family × consistency
+rung, hostile-input rejection (truncation at EVERY cut point, CRC rot,
+lying headers, trailing bytes), the empty-history and spill-shaped edge
+frames, and the lying-client fingerprint contract (server re-derives;
+claimed mismatch is evidence, never a key).
+
+Lane half: JSON vs binary submissions produce bitwise-identical
+verdicts over TCP and the unix socket; the binary stream lane appends,
+refuses finish without a final flush (soundness gate), refuses
+cross-lane mixing, and replays deterministically from the WAL after a
+daemon restart; client keep-alive reuses connections (and stops when
+JGRAFT_CLIENT_KEEPALIVE=0).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import random
+import tempfile
+
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.history.packing import (IncrementalEncoder,
+                                                     encode_history)
+from jepsen_jgroups_raft_tpu.service import (CheckingService,
+                                             ServiceClient, ServiceError,
+                                             serve_in_thread)
+from jepsen_jgroups_raft_tpu.service.admission import admit_frame
+from jepsen_jgroups_raft_tpu.service.frame import (FrameError,
+                                                   SegmentFrame,
+                                                   SubmitFrame,
+                                                   decode_frame,
+                                                   encode_segment_frame,
+                                                   encode_submit_frame)
+from jepsen_jgroups_raft_tpu.service.http import (FRAME_CONTENT_TYPE,
+                                                  serve_uds_in_thread)
+from jepsen_jgroups_raft_tpu.service.request import (build_units,
+                                                     fingerprint_encodings,
+                                                     service_workloads)
+from jepsen_jgroups_raft_tpu.service.stream import StreamConflict
+
+from util import H, random_valid_history
+
+WAIT_S = 120.0
+
+FAMILIES = ("register", "counter", "set", "queue")
+RUNGS = ("linearizable", "sequential", "session")
+
+
+def hists_for(kind: str, n: int = 2, n_ops: int = 24, seed: int = 5):
+    rng = random.Random(seed)
+    return [random_valid_history(rng, kind, n_ops=n_ops, n_procs=3,
+                                 crash_p=0.05, max_crashes=1)
+            for _ in range(n)]
+
+
+def frame_for(kind: str, rung: str, hists=None, **kw) -> tuple:
+    """(labels, encs, frame bytes) for one workload × rung."""
+    hists = hists_for(kind) if hists is None else hists
+    model, units = build_units(hists, kind)
+    labels = [lab for lab, _ in units]
+    encs = [encode_history(h, model) for _, h in units]
+    return labels, encs, encode_submit_frame(
+        kind, "auto", rung, labels, encs, **kw)
+
+
+# ---------------------------------------------------------------- codec
+
+
+@pytest.mark.parametrize("rung", RUNGS)
+@pytest.mark.parametrize("kind", FAMILIES)
+def test_roundtrip_every_family_and_rung(kind, rung):
+    """Decode(encode(x)) reproduces every tensor bit and every header
+    field, and the decoded encodings fingerprint identically to the
+    originals — the property the whole lane rests on."""
+    labels, encs, buf = frame_for(kind, rung)
+    fr = decode_frame(buf)
+    assert isinstance(fr, SubmitFrame)
+    assert (fr.workload, fr.consistency) == (kind, rung)
+    assert fr.labels == labels
+    for a, b in zip(fr.encs, encs):
+        assert np.array_equal(a.events, b.events)
+        assert np.array_equal(a.op_index, b.op_index)
+        assert (a.proc is None) == (b.proc is None)
+        if a.proc is not None:
+            assert np.array_equal(a.proc, b.proc)
+        assert (a.n_slots, a.n_ops) == (b.n_slots, b.n_ops)
+    model = service_workloads()[kind][0]()
+    assert fingerprint_encodings(model, "auto", fr.encs, rung) \
+        == fingerprint_encodings(model, "auto", encs, rung)
+
+
+def test_independent_workload_roundtrips():
+    """The multi-register split path: per-key units with key labels."""
+    h = H((0, "invoke", "write", [1, 10]), (0, "ok", "write", [1, 10]),
+          (1, "invoke", "write", [2, 20]), (1, "ok", "write", [2, 20]),
+          (0, "invoke", "read", [1, None]), (0, "ok", "read", [1, 10]))
+    labels, encs, buf = frame_for("multi-register", "linearizable", [h])
+    fr = decode_frame(buf)
+    assert fr.labels == labels and len(labels) == 2
+    assert all("/key=" in lab for lab in fr.labels)
+
+
+def test_segment_frame_roundtrip():
+    """Stream segments carry the suffix arrays plus the client
+    encoder's cumulative counters, bit-exact."""
+    enc = IncrementalEncoder(service_workloads()["register"][0]())
+    ops = hists_for("register", n=1, n_ops=30)[0].to_dicts()
+    ev, oi, pr = enc.feed([o for o in ops[:20]])
+    unit = {"events": ev, "op_index": oi, "proc": pr,
+            "n_slots": enc.n_slots, "n_ops": enc.n_ops,
+            "consumed": enc.consumed, "final": False}
+    buf = encode_segment_frame("sess-1", 3, [unit])
+    fr = decode_frame(buf)
+    assert isinstance(fr, SegmentFrame)
+    assert (fr.session, fr.seq) == ("sess-1", 3)
+    u = fr.units[0]
+    assert np.array_equal(u["events"], np.asarray(ev).reshape(-1, 5))
+    assert np.array_equal(u["op_index"], oi)
+    assert (u["n_slots"], u["n_ops"], u["consumed"], u["final"]) \
+        == (enc.n_slots, enc.n_ops, enc.consumed, False)
+
+
+def test_truncation_at_every_cut_point_rejected():
+    """EVERY proper prefix of a frame is a FrameError — no cut point
+    decodes, mis-slices, or crashes."""
+    _, _, buf = frame_for("register", "linearizable",
+                          hists_for("register", n=1, n_ops=8))
+    for cut in range(len(buf)):
+        with pytest.raises(FrameError):
+            decode_frame(buf[:cut])
+
+
+def test_crc_rot_rejected():
+    """Any single flipped byte (body, buffers, or the CRC itself) is
+    caught by the trailing CRC32."""
+    _, _, buf = frame_for("register", "linearizable",
+                          hists_for("register", n=1, n_ops=8))
+    for pos in (0, 5, 13, len(buf) // 2, len(buf) - 6, len(buf) - 1):
+        rotten = bytearray(buf)
+        rotten[pos] ^= 0x40
+        with pytest.raises(FrameError):
+            decode_frame(bytes(rotten))
+
+
+def test_lying_header_rejected():
+    """A header whose declared shapes disagree with the bytes present
+    (inflated n_events, deflated → trailing bytes) is a FrameError,
+    never a mis-sliced tensor. The CRC is re-stamped so only the
+    header lies."""
+    import json
+    import struct
+    import zlib
+
+    from jepsen_jgroups_raft_tpu.service.frame import _PREFIX, _pad
+
+    _, encs, buf = frame_for("register", "linearizable",
+                             hists_for("register", n=1, n_ops=8))
+    magic, kind, res, hdr_len = _PREFIX.unpack_from(buf, 0)
+    hdr = json.loads(buf[_PREFIX.size:_PREFIX.size + hdr_len])
+    body = buf[_PREFIX.size + hdr_len + _pad(hdr_len):-4]
+    for delta in (+7, -3):
+        lying = json.loads(json.dumps(hdr))
+        lying["units"][0]["n_events"] += delta
+        raw = json.dumps(lying, sort_keys=True,
+                         separators=(",", ":")).encode()
+        frame = _PREFIX.pack(magic, kind, res, len(raw)) + raw \
+            + b"\x00" * _pad(len(raw)) + body
+        frame += struct.pack("<I", zlib.crc32(frame))
+        with pytest.raises(FrameError):
+            decode_frame(frame)
+
+
+def test_garbage_and_wrong_kind_rejected():
+    with pytest.raises(FrameError):
+        decode_frame(b"")
+    with pytest.raises(FrameError):
+        decode_frame(b"NOPE" + b"\x00" * 64)
+    _, _, buf = frame_for("register", "linearizable",
+                          hists_for("register", n=1, n_ops=8))
+    import struct
+    import zlib
+    rotten = bytearray(buf[:-4])
+    struct.pack_into("<H", rotten, 4, 9)   # unknown kind
+    rotten += struct.pack("<I", zlib.crc32(bytes(rotten)))
+    with pytest.raises(FrameError):
+        decode_frame(bytes(rotten))
+
+
+def test_empty_history_unit_roundtrips():
+    """A zero-event unit (empty history) is a legal frame, not a
+    corner-case crash."""
+    model = service_workloads()["register"][0]()
+    enc = encode_history(H(), model)
+    assert enc.events.shape[0] == 0
+    buf = encode_submit_frame("register", "auto", "linearizable",
+                              ["h0"], [enc])
+    fr = decode_frame(buf)
+    assert fr.encs[0].events.shape == (0, 5)
+    assert fingerprint_encodings(model, "auto", fr.encs) \
+        == fingerprint_encodings(model, "auto", [enc])
+
+
+def test_spill_shaped_frame_roundtrips_zero_copy():
+    """A spill-scale unit (thousands of events) round-trips, and the
+    decoded tensors are VIEWS over the received bytes (zero-copy — the
+    decode must not reintroduce the per-request copy the lane
+    removes)."""
+    labels, encs, buf = frame_for(
+        "register", "linearizable",
+        hists_for("register", n=1, n_ops=4000, seed=11))
+    fr = decode_frame(buf)
+    assert fr.encs[0].events.shape[0] >= 4000
+    assert np.array_equal(fr.encs[0].events, encs[0].events)
+    for arr in (fr.encs[0].events, fr.encs[0].op_index):
+        assert not arr.flags.owndata and not arr.flags.writeable
+
+
+def test_admit_rederives_fingerprint_and_flags_claim_mismatch():
+    """The server ALWAYS keys on its own digest: a lying claimed
+    fingerprint is recorded as evidence in stats, never adopted and
+    never a 400."""
+    model = service_workloads()["register"][0]()
+    labels, encs, honest = frame_for("register", "linearizable")
+    want = fingerprint_encodings(model, "auto", encs)
+    req = admit_frame(honest)
+    assert req.fingerprint == want
+    assert "fingerprint_mismatch" not in req.stats
+    _, _, lying = frame_for("register", "linearizable",
+                            fingerprint="f" * 64)
+    req2 = admit_frame(lying)
+    assert req2.fingerprint == want
+    assert req2.stats["fingerprint_mismatch"] is True
+
+
+def test_admit_rejects_segment_frames():
+    """A stream segment posted at the submit surface is a 400-class
+    ValueError, not a mis-admitted request."""
+    enc = IncrementalEncoder(service_workloads()["register"][0]())
+    ev, oi, pr = enc.feed([], final=True)
+    buf = encode_segment_frame("s", 0, [{
+        "events": ev, "op_index": oi, "proc": pr,
+        "n_slots": enc.n_slots, "n_ops": enc.n_ops,
+        "consumed": enc.consumed, "final": True}])
+    with pytest.raises(ValueError):
+        admit_frame(buf)
+
+
+# ----------------------------------------------------------- HTTP lane
+
+
+class TestIngestLane:
+    @pytest.fixture()
+    def served(self):
+        svc = CheckingService(store_root=None, batch_wait=0.0)
+        httpd, port, _ = serve_in_thread(svc)
+        yield svc, f"http://127.0.0.1:{port}"
+        httpd.shutdown()
+        httpd.server_close()
+        svc.shutdown(wait=True)
+
+    def _await(self, cl, rid):
+        rec = cl.result(rid, wait_s=WAIT_S)
+        while rec["status"] not in ("done", "failed", "cancelled"):
+            rec = cl.result(rid, wait_s=WAIT_S)
+        assert rec["status"] == "done", rec
+        return rec
+
+    def test_json_and_binary_verdicts_bitwise_identical(self, served):
+        svc, url = served
+        cl = ServiceClient(url)
+        hists = hists_for("register", n=2, n_ops=30)
+        r_json = cl.submit(hists, workload="register", binary=False)
+        r_bin = cl.submit(hists, workload="register", binary=True)
+        assert r_json["fingerprint"] == r_bin["fingerprint"]
+        a = self._await(cl, r_json["id"])
+        b = self._await(cl, r_bin["id"])
+        assert a["results"] == b["results"]
+        assert a["valid?"] is True
+
+    def test_torn_frame_is_400(self, served):
+        svc, url = served
+        _, _, buf = frame_for("register", "linearizable")
+        host = url[len("http://"):]
+        conn = http.client.HTTPConnection(host, timeout=30)
+        conn.request("POST", "/submit", body=buf[:-9],
+                     headers={"Content-Type": FRAME_CONTENT_TYPE})
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        assert resp.status == 400 and b"CRC" in body
+
+    def test_uds_binary_roundtrip(self, served, tmp_path):
+        svc, url = served
+        sock = str(tmp_path / "graftd.sock")
+        uds_httpd, _ = serve_uds_in_thread(svc, sock)
+        try:
+            cl = ServiceClient("unix:" + sock)
+            rec = cl.submit(hists_for("register"), workload="register",
+                            binary=True)
+            out = self._await(cl, rec["id"])
+            assert out["valid?"] is True
+        finally:
+            uds_httpd.shutdown()
+            uds_httpd.server_close()
+
+    def test_keepalive_reuses_connections(self, served, monkeypatch):
+        svc, url = served
+        cl = ServiceClient(url)
+        for i in range(3):
+            cl.submit(hists_for("register", seed=100 + i),
+                      workload="register")
+        assert cl.conn_opened == 1 and cl.conn_reused >= 2
+        monkeypatch.setenv("JGRAFT_CLIENT_KEEPALIVE", "0")
+        cl2 = ServiceClient(url)
+        for i in range(3):
+            cl2.submit(hists_for("register", seed=200 + i),
+                       workload="register")
+        assert cl2.conn_reused == 0
+
+
+# --------------------------------------------------------- binary stream
+
+
+class TestBinaryStream:
+    def _serve(self, store):
+        svc = CheckingService(store_root=store, batch_wait=0.0)
+        httpd, port, _ = serve_in_thread(svc)
+        return svc, httpd, ServiceClient(f"http://127.0.0.1:{port}")
+
+    def test_binary_stream_matches_json_stream(self, tmp_path):
+        svc, httpd, cl = self._serve(str(tmp_path / "a"))
+        try:
+            ops = hists_for("register", n=1, n_ops=40)[0].to_dicts()
+            outs = []
+            for binary in (True, False):
+                s = cl.stream(workload="register", binary=binary)
+                for i in range(0, len(ops), 16):
+                    st = s.append(ops[i:i + 16])
+                outs.append(s.finish())
+                assert st.get("mode", "json") == \
+                    ("binary" if binary else "json")
+            assert outs[0]["valid?"] is True
+            assert outs[0]["valid?"] == outs[1]["valid?"]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.shutdown(wait=True)
+
+    def test_finish_without_final_flush_conflicts(self, tmp_path):
+        """Soundness gate: the client's final flush carries crashed-pair
+        OPEN events (linearization candidates); a finish that never saw
+        a final-flagged segment for an undecided unit is a 409."""
+        svc, httpd, cl = self._serve(str(tmp_path / "a"))
+        try:
+            s = cl.stream(workload="register", binary=True)
+            s.append(hists_for("register", n=1)[0].to_dicts())
+            with pytest.raises(StreamConflict):
+                svc.streams.finish(s.session_id)
+            # the client-driven finish auto-sends the final flush
+            out = s.finish()
+            assert out["valid?"] is True
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.shutdown(wait=True)
+
+    def test_cross_lane_mixing_conflicts(self, tmp_path):
+        svc, httpd, cl = self._serve(str(tmp_path / "a"))
+        try:
+            ops = hists_for("register", n=1, n_ops=20)[0].to_dicts()
+            sb = cl.stream(workload="register", binary=True)
+            sb.append(ops[:10])
+            with pytest.raises(StreamConflict):
+                svc.streams.append(sb.session_id, 1,
+                                   [[o for o in ops[10:]]], n_bytes=0)
+            sj = cl.stream(workload="register", binary=False)
+            sj.append(ops[:10])
+            sess = svc.streams._touch(sj.session_id)
+            enc = IncrementalEncoder(
+                service_workloads()["register"][0]())
+            enc.feed(ops[:10])
+            ev, oi, pr = enc.feed(ops[10:])
+            with pytest.raises(StreamConflict):
+                sess.append_binary(2, [{
+                    "events": ev, "op_index": oi, "proc": pr,
+                    "n_slots": enc.n_slots, "n_ops": enc.n_ops,
+                    "consumed": enc.consumed, "final": False}],
+                    n_bytes=0)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.shutdown(wait=True)
+
+    def test_binary_resume_refused_client_side(self, tmp_path):
+        svc, httpd, cl = self._serve(str(tmp_path / "a"))
+        try:
+            with pytest.raises(ValueError):
+                cl.stream(workload="register", binary=True,
+                          resume="sess-x")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.shutdown(wait=True)
+
+    def test_wal_replay_restores_binary_session(self, tmp_path):
+        """Daemon restart mid-stream: the bseg WAL records rebuild the
+        session (mode, counters, per-unit encoder state) and the
+        revived session finishes with the same verdict a continuous
+        run produces."""
+        store = str(tmp_path / "a")
+        svc, httpd, cl = self._serve(store)
+        ops = hists_for("register", n=1, n_ops=40, seed=9)[0].to_dicts()
+        s = cl.stream(workload="register", binary=True)
+        s.append(ops[:20])
+        s.append(ops[20:])
+        sid = s.session_id
+        httpd.shutdown()
+        httpd.server_close()
+        svc.shutdown(wait=True)
+
+        svc2 = CheckingService(store_root=store, batch_wait=0.0)
+        try:
+            sess = svc2.streams._touch(sid)
+            # client seqs start at 1: two appends -> next expected is 3
+            assert sess.mode == "binary" and sess.seq_next == 3
+            # the final flush died with the old client: a revived
+            # binary session still enforces the soundness gate
+            with pytest.raises(StreamConflict):
+                svc2.streams.finish(sid)
+            enc = IncrementalEncoder(
+                service_workloads()["register"][0]())
+            enc.feed(ops)
+            ev, oi, pr = enc.feed([], final=True)
+            svc2.streams.append_binary(sid, 3, [{
+                "events": ev, "op_index": oi, "proc": pr,
+                "n_slots": enc.n_slots, "n_ops": enc.n_ops,
+                "consumed": enc.consumed, "final": True}], n_bytes=0)
+            rec = svc2.streams.finish(sid)
+            assert rec["valid?"] is True
+        finally:
+            svc2.shutdown(wait=True)

@@ -248,6 +248,70 @@ def test_gating_off_without_autotune(monkeypatch, tmp_path):
     assert not list(tmp_path.glob("**/linfp-*.json"))
 
 
+def test_shared_gate_dir_replicates_across_replicas(monkeypatch,
+                                                    tmp_path):
+    """ISSUE-18 satellite: two replicas with DISTINCT autotune stores
+    but a shared JGRAFT_LINFP_DIR. Replica A trains a low-hit bucket;
+    replica B — zero observations of its own — inherits the published
+    gate record and routes kernel-first immediately. Without the
+    shared dir, B starts untrained (routes fastpath-first)."""
+    monkeypatch.setenv("JGRAFT_AUTOTUNE", "1")
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH_MIN_OBS", "8")
+    monkeypatch.setenv("JGRAFT_LINFP_DIR", str(tmp_path / "cluster"))
+    sig = autotune.lin_fastpath_sig("CasRegister", 40)
+    # replica A: private store, trains the bucket, publishes
+    monkeypatch.setenv("JGRAFT_AUTOTUNE_STORE", str(tmp_path / "a"))
+    autotune.reset_for_tests()
+    autotune.lin_fastpath_observe(sig, rows=32, hits=0, wall_s=0.05)
+    assert autotune.lin_fastpath_route(sig) is False
+    shared = list((tmp_path / "cluster" / "linfp").glob("linfp-*.json"))
+    assert shared, "gate record was not published to the shared dir"
+    # replica B: fresh memory + DIFFERENT private store, inherits
+    monkeypatch.setenv("JGRAFT_AUTOTUNE_STORE", str(tmp_path / "b"))
+    autotune.reset_for_tests()
+    assert autotune.lin_fastpath_route(sig) is False
+    # control: without the shared dir, B would be untrained
+    monkeypatch.delenv("JGRAFT_LINFP_DIR")
+    autotune.reset_for_tests()
+    assert autotune.lin_fastpath_route(sig) is True
+
+
+def test_shared_gate_reenables_fastpath_in_wavefront(monkeypatch,
+                                                     tmp_path):
+    """ISSUE-18 satellite: inside an active distributed wavefront the
+    fast path stays off (host-local gate state would desync SPMD
+    eviction) — unless the shared gate dir is configured, in which
+    case certifiable rows are evicted before sharding. All rows here
+    certify, so the kernel path (and its collectives) is never
+    reached."""
+    from jepsen_jgroups_raft_tpu.parallel import distributed
+
+    monkeypatch.setenv("JGRAFT_LIN_FASTPATH", "1")
+    monkeypatch.setenv("JGRAFT_AUTOTUNE", "0")
+    monkeypatch.setattr(distributed, "wavefront_active", lambda: True)
+    seen = []
+    monkeypatch.setattr(
+        distributed, "run_sharded",
+        lambda encs, check_local, **kw: seen.append(len(encs))
+        or check_local(list(encs)))
+    rng = random.Random(3)
+    hists = [random_valid_history(rng, "register", n_ops=24,
+                                  crash_p=0.0) for _ in range(4)]
+    m = CasRegister()
+    consume_fastpath_counters()
+    rs1 = check_histories(hists, m, algorithm="jax")
+    c1 = consume_fastpath_counters()
+    # no shared dir: wavefront stays kernel-first (run_sharded saw all)
+    assert c1["rows_scanned"] == 0 and seen == [4]
+    seen.clear()
+    monkeypatch.setenv("JGRAFT_LINFP_DIR", str(tmp_path / "cluster"))
+    rs2 = check_histories(hists, m, algorithm="jax")
+    c2 = consume_fastpath_counters()
+    assert c2["rows_certified"] == 4 and seen == []
+    assert [r["valid?"] for r in rs1] == [r["valid?"] for r in rs2]
+    assert all(r["valid?"] is VALID for r in rs2)
+
+
 # ------------------------------------------------------- host ladder
 
 
