@@ -707,17 +707,20 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
         from jepsen_jgroups_raft_tpu.checker.linearizable import \
             check_encoded
 
-        from jepsen_jgroups_raft_tpu.checker.schedule import consume_tiers
+        from jepsen_jgroups_raft_tpu.checker.schedule import (consume_stats,
+                                                              consume_tiers)
 
         sub = encs[:min(len(encs), 256)]
         check_encoded(sub, model, algorithm="jax",
                       consistency="sequential")  # warm-up: compile
         beat()
+        consume_stats()  # drop the warm-up's scan/cycle counters
         consume_tiers()  # drop the warm-up's tier counters
         t0 = time.perf_counter()
         rs = check_encoded(sub, model, algorithm="jax",
                            consistency="sequential")
         dt_seq = time.perf_counter() - t0
+        scan_seq = consume_stats()
         tiers = consume_tiers()
         emit({
             "metric": "sequential_rung_hist_per_sec",
@@ -734,6 +737,14 @@ def run_bench(n_histories: int, n_ops: int, platform_note: str) -> None:
             "decided_by_tier": {k: v["rows"] for k, v in tiers.items()},
             "tier_wall_s": {k: round(v["wall_s"], 4)
                             for k, v in tiers.items()},
+            # ISSUE 19 cycle-tier evidence on the rung that runs it:
+            # size-cap skips are never silent, and the condensation /
+            # blocked-kernel work is visible per row.
+            "cycle_size_skipped_rows": scan_seq["cycle_size_skips"],
+            "cycle_nodes_pre_condense": scan_seq["cycle_nodes_pre"],
+            "cycle_nodes_post_condense": scan_seq["cycle_nodes_post"],
+            "cycle_scc_hits": scan_seq["cycle_scc_hits"],
+            "cycle_tiles_run": scan_seq["cycle_tiles_run"],
             "time_s": round(dt_seq, 3),
             "platform": jax.devices()[0].platform,
         })
@@ -930,6 +941,15 @@ def run_suite(platform_note: str) -> None:
               "evicted_rows": scan["evicted_rows"],
               "chunks_run": scan["chunks_run"],
               "pipeline_overlap_s": round(scan["pipeline_overlap_s"], 3),
+              # ISSUE 19 cycle-tier evidence: size-cap skips are never
+              # silent, and the condensation/tiling work is visible on
+              # every row (nonzero where the cycle tier actually ran —
+              # the rung rows).
+              "cycle_size_skipped_rows": scan["cycle_size_skips"],
+              "cycle_nodes_pre_condense": scan["cycle_nodes_pre"],
+              "cycle_nodes_post_condense": scan["cycle_nodes_post"],
+              "cycle_scc_hits": scan["cycle_scc_hits"],
+              "cycle_tiles_run": scan["cycle_tiles_run"],
               "host_fingerprint": host_fingerprint(),
               "platform": platform})
 
@@ -1007,6 +1027,17 @@ def run_suite(platform_note: str) -> None:
     # cheaper.
     timed("8: set 1000x1k @sequential", GSet(), set_hs,
           consistency="sequential")
+
+    # 9: list-append (ISSUE 19) — the transactional workload's per-key
+    # face: ≤6 unique appends per history (the packed int32 cap), the
+    # rest reads observing the whole list. The cross-key anomaly rung
+    # is priced separately (scripts/ab_cycle.py); this row prices the
+    # frontier-model path at the suite's shape discipline.
+    from jepsen_jgroups_raft_tpu.models.listappend import ListAppend
+    hs = [random_valid_history(rng, "list-append", n_ops=sz(1000, 50),
+                               n_procs=5, crash_p=0.05, max_crashes=3)
+          for _ in range(sz(1000, 8))]
+    timed("9: list-append 1000x1k", ListAppend(), hs)
 
 
 def run_service(platform_note: str) -> None:

@@ -279,6 +279,7 @@ def reset_for_tests() -> None:
         _MEM.clear()
         _APPLIED.clear()
         _LINFP_MEM.clear()
+        _CYCLE_MEM.clear()
         for k in _COUNTERS:
             _COUNTERS[k] = 0
 
@@ -773,6 +774,134 @@ def lin_fastpath_observe(sig: tuple, rows: int, hits: int,
         except OSError as e:
             _log.warning("autotune: could not persist lin-fastpath "
                          "record %s (%s: %s)", path, type(e).__name__, e)
+
+
+# ------------------------------------------------- cycle-tier arm store
+# ISSUE 19: the exact cycle tier has two routing dimensions per node
+# bucket — condense-vs-direct (host Tarjan pre-pass or straight to the
+# detector) and kernel-vs-DFS (batched closure launch or host 3-color
+# DFS). Host-CPU thresholds are explicitly re-calibratable (ROADMAP
+# item 5), so the choice is measured per bucket with the same
+# plan-store discipline as the launch plans: fingerprint-keyed JSON,
+# version/signature checks, in-memory negative cache, interleaved
+# rotated best-of-min measurement. Arm choice is ROUTING ONLY — every
+# arm is verdict-identical (differentially pinned in
+# tests/test_cycle_tiled.py), so a stale or foreign record can only
+# cost time, never answers.
+
+#: cycle-arm record schema version; unknown versions re-measure.
+CYCLE_ARM_VERSION = 1
+
+#: Measurable arms, in deterministic measurement order: "condense" =
+#: host Tarjan SCC pre-pass (detection IS the pre-pass), "dfs" = direct
+#: host 3-color DFS, "kernel" = direct batched closure launch.
+CYCLE_ARMS = ("condense", "dfs", "kernel")
+
+_CYCLE_MEM: dict = {}   # sig -> arm str | _MISS
+
+
+def cycle_arm_sig(n_bucket: int) -> tuple:
+    """Arm bucket: the pow2+midpoint node bucket alone. The arm
+    tradeoff is a property of graph size and host-vs-device matmul
+    cost, not of the model family — fragmenting per family would
+    starve small buckets of measurements."""
+    return ("cycle-arm", int(n_bucket))
+
+
+def _cycle_arm_path(sig: tuple) -> Path:
+    return store_root() / host_fingerprint() / f"cycle-arm-n{sig[1]}.json"
+
+
+def cycle_arm_for(sig: tuple) -> Optional[str]:
+    """The bucket's measured arm: memory, then the fingerprint store.
+    Same failure stance as `plan_for` — corrupt/stale/foreign records
+    return None (re-measure, never silently mis-route), misses are
+    negative-cached so per-batch consults stay disk-free."""
+    with _LOCK:
+        arm = _CYCLE_MEM.get(sig)
+    if arm is _MISS:
+        return None
+    if arm is not None:
+        _bump("plans_loaded")
+        return arm
+    path = _cycle_arm_path(sig)
+    try:
+        raw = json.loads(path.read_text())
+    except FileNotFoundError:
+        return _cycle_miss(sig)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        _log.warning("autotune: unreadable cycle-arm record %s (%s: %s)"
+                     " — re-measuring", path, type(e).__name__, e)
+        return _cycle_miss(sig)
+    arm = raw.get("arm")
+    if (raw.get("version") != CYCLE_ARM_VERSION
+            or raw.get("fingerprint") != host_fingerprint()
+            or raw.get("signature") != list(sig)
+            or arm not in CYCLE_ARMS):
+        _log.warning("autotune: stale/corrupt cycle-arm record %s — "
+                     "re-measuring", path)
+        return _cycle_miss(sig)
+    with _LOCK:
+        _CYCLE_MEM[sig] = arm
+    _bump("plans_loaded")
+    return arm
+
+
+def _cycle_miss(sig: tuple):
+    with _LOCK:
+        _CYCLE_MEM[sig] = _MISS
+        _COUNTERS["plan_misses"] += 1
+    return None
+
+
+def save_cycle_arm(sig: tuple, arm: str, samples: dict) -> None:
+    """Persist a measured arm (atomic tmp+rename; persistence failures
+    warn and keep the in-memory arm)."""
+    with _LOCK:
+        _CYCLE_MEM[sig] = arm
+    path = _cycle_arm_path(sig)
+    payload = {
+        "version": CYCLE_ARM_VERSION,
+        "fingerprint": host_fingerprint(),
+        "fingerprint_info": fingerprint_info(),
+        "signature": list(sig),
+        "arm": arm,
+        "samples": samples,
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(f".{os.getpid()}.tmp")
+        tmp.write_text(json.dumps(payload, indent=2))
+        os.replace(tmp, path)
+    except OSError as e:
+        _log.warning("autotune: could not persist cycle-arm record %s "
+                     "(%s: %s)", path, type(e).__name__, e)
+
+
+def resolve_cycle_arm(sig: tuple,
+                      measures: "dict[str, Callable[[], float]]") -> str:
+    """Measure the available arms interleaved (one untimed warm-up rep
+    each absorbs XLA compiles, then `sample_reps` rounds with rotating
+    order — the resolve_plan discipline verbatim), pick best-of-min,
+    persist, return. `measures` maps arm name → zero-arg wall-seconds
+    measurement over the SAME batch of graphs; the caller asserts
+    verdict identity across arms before trusting any timing (the
+    scripts/ab_cycle.py stance, applied in-process)."""
+    arms = [a for a in CYCLE_ARMS if a in measures]
+    times: dict = {a: [] for a in arms}
+    for a in arms:
+        measures[a]()
+    reps = sample_reps()
+    for rep in range(reps):
+        order = arms[rep % len(arms):] + arms[:rep % len(arms)]
+        for a in order:
+            times[a].append(measures[a]())
+    best = min(arms, key=lambda a: min(times[a]))
+    samples = {a: [round(t, 6) for t in ts] for a, ts in times.items()}
+    save_cycle_arm(sig, best, samples)
+    _bump("plans_measured")
+    return best
 
 
 def sort_rung_sharding(tuned: Optional[TunedPlan]):

@@ -382,6 +382,7 @@ def check_encoded(
         # (doc §15) — so undecided rows consult it BEFORE paying the
         # relax + kernel-ladder pass. The tier only ever refutes;
         # cycle-free rows fall through unchanged.
+        cycle_skips: dict = {}
         if todo and consistency == "sequential":
             from .cycle import cycle_tier_on, find_cycles
 
@@ -390,7 +391,14 @@ def check_encoded(
                 cyc = find_cycles([encs[i] for i in todo], model)
                 dt_cyc = time.perf_counter() - t0
                 hits = [(j, i) for j, i in enumerate(todo)
-                        if cyc[j] is not None]
+                        if cyc[j] is not None and "cycle" in cyc[j]]
+                # rows too big for the tier get the size-skip stamped
+                # on whatever result the ladder reaches below (ISSUE
+                # 19 satellite: the cap skip used to be invisible)
+                cycle_skips.update(
+                    (i, cyc[j]["skipped-size"]) for j, i in
+                    enumerate(todo)
+                    if cyc[j] is not None and "skipped-size" in cyc[j])
                 for j, i in hits:
                     results[i] = {
                         "valid?": INVALID, "algorithm": "cycle",
@@ -424,6 +432,9 @@ def check_encoded(
                 results[i] = r
         if consistency == "session":
             _annotate_sc_refutations(encs, results, model)
+        for i, n_skipped in cycle_skips.items():
+            if results[i] is not None:
+                results[i]["cycle-skipped-size"] = n_skipped
         for r in results:
             r["consistency"] = consistency
         return results  # type: ignore[return-value]
@@ -517,9 +528,13 @@ def _annotate_sc_refutations(encs, results, model) -> None:
     except Exception:
         return  # evidence must never take down a sound verdict
     for r, c in zip(results, cyc):
-        if c is not None and r is not None:
+        if c is None or r is None:
+            continue
+        if "cycle" in c:
             r["sc-refuted"] = True
             r["sc-cycle"] = c["cycle"]
+        elif "skipped-size" in c:
+            r["cycle-skipped-size"] = c["skipped-size"]
 
 
 def _check_encoded(
@@ -1117,9 +1132,11 @@ def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
                     [c] = find_cycles([orig], model, kernel=False)
                 except Exception:
                     c = None
-                if c is not None:
+                if c is not None and "cycle" in c:
                     res["sc-refuted"] = True
                     res["sc-cycle"] = c["cycle"]
+                elif c is not None and "skipped-size" in c:
+                    res["cycle-skipped-size"] = c["skipped-size"]
             return res
 
         [enc], [certified], [tier] = apply_rung([enc], model, consistency)
@@ -1139,7 +1156,7 @@ def check_encoded_host(enc: EncodedHistory, model, witness: bool = False,
 
             if cycle_tier_on():
                 [c] = find_cycles([orig], model, kernel=False)
-                if c is not None:
+                if c is not None and "cycle" in c:
                     note_tier("cycle")
                     return {"valid?": INVALID, "algorithm": "cycle",
                             "op-count": orig.n_ops,

@@ -110,6 +110,13 @@ class PerfChecker(Checker):
         fp = lin_fastpath_summary()
         if fp is not None:
             out["lin-fastpath"] = fp
+        # Exact-cycle tier counters (ISSUE 19): size skips (the
+        # previously-invisible cap skip), condensation effectiveness
+        # (nodes pre/post, SCC hits) and blocked-closure tile volume —
+        # absent when the tier never touched a graph this run.
+        cyc = cycle_stats_summary()
+        if cyc is not None:
+            out["cycle-stats"] = cyc
         store_dir = (test or {}).get("store_dir")
         if self.render and store_dir:
             try:
@@ -181,6 +188,33 @@ def lin_fastpath_summary():
             "rows-gated": c["rows_gated"],
             "rows-rung-skipped": c["rows_rung_skipped"],
             "certify-wall-s": round(c["certify_wall_s"], 4)}
+
+
+def format_cycle_stats(scan: dict):
+    """Result-dict form of the cycle-tier counters riding a raw
+    schedule counter dict, or None when the tier never built a graph
+    and never skipped one (absent beats all-zero in stored results).
+    ``size-skipped-rows`` is the ISSUE-19 satellite: rows whose
+    required-op graph exceeded JGRAFT_CYCLE_MAX_OPS used to vanish
+    from every stats surface."""
+    keys = ("cycle_size_skips", "cycle_nodes_pre", "cycle_nodes_post",
+            "cycle_scc_hits", "cycle_tiles_run")
+    if not any(scan.get(k) for k in keys):
+        return None
+    return {"size-skipped-rows": scan.get("cycle_size_skips", 0),
+            "nodes-pre-condense": scan.get("cycle_nodes_pre", 0),
+            "nodes-post-condense": scan.get("cycle_nodes_post", 0),
+            "scc-hits": scan.get("cycle_scc_hits", 0),
+            "tiles-run": scan.get("cycle_tiles_run", 0)}
+
+
+def cycle_stats_summary():
+    """Per-run cycle-tier counters (checker/schedule.note_cycle), or
+    None when the tier never engaged. Scoped like
+    `scan_stats_summary` — the innermost active `stats_scope` wins."""
+    from .schedule import snapshot_stats
+
+    return format_cycle_stats(snapshot_stats(scoped=True))
 
 
 def format_tier_stats(tiers: dict):

@@ -90,7 +90,14 @@ def scan_chunk() -> int:
 
 _STATS_LOCK = threading.Lock()
 _STATS_ZERO = {"chunks_run": 0, "evicted_rows": 0, "groups_run": 0,
-               "groups_early_exited": 0, "pipeline_overlap_s": 0.0}
+               "groups_early_exited": 0, "pipeline_overlap_s": 0.0,
+               # cycle-tier counters (ISSUE 19): rows that skipped the
+               # exact tier for size (the previously-silent cap skip),
+               # graph nodes before/after SCC condensation, non-trivial
+               # SCCs hit, and blocked-closure tile programs run.
+               "cycle_size_skips": 0, "cycle_nodes_pre": 0,
+               "cycle_nodes_post": 0, "cycle_scc_hits": 0,
+               "cycle_tiles_run": 0}
 _STATS = dict(_STATS_ZERO)
 #: (scope dict, owner thread id) pairs; guarded by _STATS_LOCK,
 #: innermost last. The owner id makes attribution THREAD-AFFINE under
@@ -113,6 +120,19 @@ def _add_stats(**kw) -> None:
             _STATS[k] += v
             for scope in targets:
                 scope[k] += v
+
+
+def note_cycle(**kw) -> None:
+    """Record cycle-tier counters (ISSUE 19) into the active scopes +
+    process totals — ``cycle_size_skips`` is the previously-invisible
+    cap skip (satellite: a row too big for the exact tier now leaves a
+    trace in every stats surface), the rest feed the bench rung rows'
+    ``cycle_*`` fields. Unknown keys are a programming error, caught
+    loudly here rather than silently minted as new counters."""
+    for k in kw:
+        if k not in _STATS_ZERO:
+            raise KeyError(f"unknown cycle counter {k!r}")
+    _add_stats(**kw)
 
 
 @contextlib.contextmanager

@@ -42,8 +42,10 @@ def random_valid_history(
 
     model_kind: "register" (read/write/cas), "counter"
     (read/add/add-and-get), "set" (add/read over the 32-wide
-    membership), or "queue" (ticket-FIFO enqueue/dequeue, completed
-    enqueues observing their assigned ticket). crash_p biases how often
+    membership), "queue" (ticket-FIFO enqueue/dequeue, completed
+    enqueues observing their assigned ticket), or "list-append"
+    (unique-element appends observing the resulting list, reads
+    observing the whole list — ISSUE 19). crash_p biases how often
     a pending op crashes instead of completing (info ops are the
     checker-pressure knob).
 
@@ -66,8 +68,13 @@ def random_valid_history(
         state = None
     elif model_kind == "queue":
         state = (0, 0)  # (head, tail)
+    elif model_kind == "list-append":
+        state = []  # the append-only list itself
     else:
         state = 0  # counter value / set membership mask
+    # list-append: unique elements 1..MAX_LEN (the packed int32 state
+    # admits at most 6), then the generator degrades to reads
+    next_elem = 1
     rows = []
     # pending: process -> dict(f, value, linearized?, result)
     pending: dict = {}
@@ -107,6 +114,12 @@ def random_valid_history(
             elif model_kind == "queue":
                 f = rng.choice(["enqueue", "enqueue", "dequeue"])
                 value = None
+            elif model_kind == "list-append":
+                if next_elem <= 6 and rng.random() < 0.5:
+                    f, value = "append", next_elem
+                    next_elem += 1
+                else:
+                    f, value = "read", None
             else:
                 f = rng.choice(["read", "add", "add-and-get"])
                 value = None if f == "read" else rng.randrange(1, value_range + 1)
@@ -147,6 +160,10 @@ def random_valid_history(
                 else:
                     state = (h + 1, t)
                     d["result"] = h
+            elif model_kind == "list-append":
+                if f == "append":
+                    state = state + [v]
+                d["result"] = list(state)  # the observed/resulting list
             else:
                 if f == "read":
                     d["result"] = state
@@ -165,8 +182,8 @@ def random_valid_history(
                 rows.append((p, FAIL, f, d["value"]))
             elif f == "read":
                 rows.append((p, OK, f, r))
-            elif f in ("add-and-get", "enqueue", "dequeue"):
-                rows.append((p, OK, f, r))  # observed ticket / new value
+            elif f in ("add-and-get", "enqueue", "dequeue", "append"):
+                rows.append((p, OK, f, r))  # observed result/ticket/list
             else:
                 rows.append((p, OK, f, d["value"]))
             free.append(p)

@@ -187,6 +187,30 @@ def _ir_chunk_budget(interp: Interp) -> List[str]:
             elif n > 16 << 20:
                 out.append(f"cycle adjacency slab at N={N} = {n} B "
                            "exceeds usable per-core VMEM")
+    # Blocked-closure tile slab (ISSUE 19): the tiled kernel keeps a
+    # [T,N] row panel, a [T,N] col panel, one streamed [T,N] product
+    # panel and the [T,T] pivot diagonal resident — the budget binding
+    # moves to TILE granularity, so the proof samples the tiled cap at
+    # the default tile, the minimum tile, and the first post-monolithic
+    # bucket. A cap or tile bump fails here until re-proven.
+    fn_ct = interp.functions.get("cycle_closure_tile_bytes")
+    cap_tn = interp.module_env.get("CYCLE_MAX_NODES_TILED")
+    tile_t = interp.module_env.get("CYCLE_TILE")
+    if fn_ct is None or not all(isinstance(v, int)
+                                for v in (cap_tn, tile_t)):
+        out.append(("kernel-unresolved",
+                    "cycle_closure_tile_bytes / CYCLE_MAX_NODES_TILED / "
+                    "CYCLE_TILE not resolvable"))
+    else:
+        for N, T in ((cap_tn, tile_t), (cap_tn, 2), (1024, tile_t)):
+            n = interp.exec_fn(fn_ct, {"n_nodes": N, "tile": T})
+            if not isinstance(n, int):
+                out.append(("kernel-unresolved",
+                            f"cycle_closure_tile_bytes({N}, {T}) "
+                            "not evaluable"))
+            elif n > 16 << 20:
+                out.append(f"blocked cycle-closure tile slab at (N={N}, "
+                           f"T={T}) = {n} B exceeds usable per-core VMEM")
     fn_r = interp.functions.get("macro_row_ints")
     cap_p = interp.module_env.get("MACRO_MAX_OPENS")
     if fn_r is None or not isinstance(cap_p, int):
@@ -275,6 +299,15 @@ CONTRACTS: Dict[str, Contract] = {
         # node cap (the custom binding also executes the accounting fn).
         ("2 * CYCLE_MAX_NODES * CYCLE_MAX_NODES * 4", 16 << 20,
          "cycle adjacency slab at the node cap exceeds VMEM"),
+        # ISSUE 19: the blocked-closure tile slab at the TILED cap —
+        # the per-tile binding (3 [T,N] panels + the [T,T] diagonal)
+        # that lets N grow past the monolithic 512 cap. The custom
+        # binding also executes cycle_closure_tile_bytes at corners.
+        ("(3 * CYCLE_TILE * CYCLE_MAX_NODES_TILED + "
+         "CYCLE_TILE * CYCLE_TILE) * 4", 16 << 20,
+         "blocked cycle-closure tile slab at the tiled cap exceeds "
+         "VMEM; re-prove before raising CYCLE_MAX_NODES_TILED or "
+         "CYCLE_TILE"),
     ], custom=_ir_chunk_budget),
     "ops/dense_scan.py": Contract(const_asserts=[
         # Re-assert the caps through dense_scan's own import site: the

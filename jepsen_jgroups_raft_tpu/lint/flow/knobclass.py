@@ -79,9 +79,11 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_CERTIFY_BATCH_MIN": ROUTING,
     "JGRAFT_CERTIFY_BATCH_MIN_HIT": ROUTING,
     "JGRAFT_CERTIFY_BATCH_MIN_OBS": ROUTING,
+    "JGRAFT_CYCLE_CONDENSE": ROUTING,
     "JGRAFT_CYCLE_KERNEL": ROUTING,
     "JGRAFT_CYCLE_MAX_OPS": ROUTING,
     "JGRAFT_CYCLE_TIER": ROUTING,
+    "JGRAFT_CYCLE_TILE": ROUTING,
     "JGRAFT_DISTRIBUTED": ROUTING,
     "JGRAFT_DISTRIBUTED_AUTODETECT": ROUTING,
     "JGRAFT_DISTRIBUTED_VDEVS": ROUTING,

@@ -15,6 +15,7 @@ from .counter import Counter  # noqa: F401
 from .leader import LeaderModel  # noqa: F401
 from .setmodel import GSet  # noqa: F401
 from .queuemodel import TicketQueue  # noqa: F401
+from .listappend import ListAppend  # noqa: F401
 
 #: name → constructor, used by workloads and the CLI.
 MODELS = {
@@ -23,4 +24,5 @@ MODELS = {
     "leader": LeaderModel,
     "set": GSet,
     "queue": TicketQueue,
+    "list-append": ListAppend,
 }

@@ -85,10 +85,27 @@ SORT_MAX_SLOTS = 127
 SORT_DEFAULT_CONFIGS = 256
 
 #: Cycle-tier cap (ISSUE 13): dependency graphs beyond this many nodes
-#: skip the exact refutation tier (the kernel ladder still decides
-#: them). The adjacency slab at this cap is proven against the VMEM
-#: budget by the kernel-contract analyzer (cycle_adjacency_bytes).
+#: skip the MONOLITHIC closure kernel (make_cycle_closure keeps the
+#: whole [N, N] slab resident). The adjacency slab at this cap is
+#: proven against the VMEM budget by the kernel-contract analyzer
+#: (cycle_adjacency_bytes).
 CYCLE_MAX_NODES = 512
+
+#: Blocked-closure cap (ISSUE 19): the tiled kernel
+#: (make_cycle_closure_tiled) streams [T, N] panels instead of the
+#: whole matrix, so the node ceiling rises 8× — the per-k-step panel
+#: residency is what the kernel-contract analyzer proves now
+#: (cycle_closure_tile_bytes, executed at THIS corner). Rows beyond
+#: this cap skip the exact tier entirely (and say so: the
+#: cycle-skipped-size annotation, checker/cycle.py).
+CYCLE_MAX_NODES_TILED = 4096
+
+#: Default closure tile edge. 256 is the largest pow2 whose panel set
+#: fits the VMEM budget at the 4096-node cap ((3·T·N + T²)·4 ≈ 12.9 MB
+#: at T=256, N=4096; T=512 would need 25 MB) — and every node bucket
+#: the pow2+midpoint series emits above 512 (768, 1024, 1536, ...) is
+#: a multiple of 256, so the default tile always divides the bucket.
+CYCLE_TILE = 256
 
 
 def scan_unroll() -> int:
@@ -395,6 +412,105 @@ def make_cycle_closure(n_nodes: int):
     return jax.jit(closure)
 
 
+def cycle_closure_tile(n_nodes: int, tile: int) -> int:
+    """Effective tile edge for a bucket: the largest power of two ≤
+    ``tile`` that divides ``n_nodes``.  Every bucket the pow2+midpoint
+    series emits above 512 is a multiple of 256, so the shipped default
+    (CYCLE_TILE) always survives intact; the clamp only matters for
+    operator-forced JGRAFT_CYCLE_TILE values that don't divide a
+    midpoint bucket (768 = 3·256 admits any pow2 ≤ 256, not 512)."""
+    n, t = int(n_nodes), int(tile)
+    t = min(t, n)
+    if t >= 1:
+        t = 1 << (t.bit_length() - 1)  # largest pow2 ≤ t
+    while t > 1 and n % t:
+        t //= 2
+    return max(t, 1)
+
+
+def make_cycle_closure_tiled(n_nodes: int, tile: int = CYCLE_TILE):
+    """Blocked transitive-closure kernel (ISSUE 19): same contract as
+    make_cycle_closure — ``closure(adj)`` over [B, N, N] int32 0/1
+    adjacency, returns (has_cycle [B] bool, closed [B, N, N]) — but
+    built as blocked Floyd–Warshall over [T, T] int32 tiles so the
+    live working set per step is panels, not the whole matrix, and the
+    node cap rises to CYCLE_MAX_NODES_TILED.
+
+    One pass over the N/T diagonal blocks; for pivot block k (offset
+    o = k·T):
+
+      1. close the diagonal block D = A[o:o+T, o:o+T] by repeated
+         boolean squaring (ceil(log2 T) iterations — all paths that
+         stay inside the pivot block);
+      2. fold the closed pivot into its row panel (R ← R ∨ D*·R) and
+         column panel (C ← C ∨ C·D*);
+      3. A ← A ∨ C·R, streamed one [T, N] row-panel product at a time
+         so the largest materialized intermediate is a panel, never
+         [N, N].
+
+    This is the textbook blocked FW schedule: after processing pivot
+    k, A[i, j] holds every path whose intermediate nodes lie in blocks
+    ≤ k, so the final A is the full transitive closure — identical to
+    the monolithic squaring (differentially pinned in
+    tests/test_cycle_tiled.py).  Soundness is monotone: entries are
+    only ever OR-ed with products of existing path bits, so every set
+    bit is a real path at every step.  Entries re-binarize after every
+    product (jnp.minimum(·, 1)), so int32 row sums stay ≤ N — no
+    overflow at any cap.
+
+    Per-k-step residency is what the kernel-contract analyzer proves
+    now (cycle_closure_tile_bytes, executed at the
+    (CYCLE_MAX_NODES_TILED, CYCLE_TILE) corner); the [B, N, N] slab
+    itself lives in HBM like every other chunked carry.
+    """
+    n, t = int(n_nodes), int(tile)
+    if n < 1 or t < 1 or n % t:
+        raise ValueError(f"tile {t} does not divide node bucket {n}")
+    nt = n // t
+    diag_iters = max(1, (max(t, 2) - 1).bit_length())
+
+    def closure(adj):
+        a0 = adj.astype(jnp.int32)
+        b = a0.shape[0]
+
+        def sq_once(_i, d):
+            p = jnp.einsum("bij,bjk->bik", d, d,
+                           preferred_element_type=jnp.int32)
+            return jnp.minimum(d + jnp.minimum(p, 1), 1)
+
+        def pivot(kb, a):
+            o = kb * t
+            d = lax.dynamic_slice(a, (0, o, o), (b, t, t))
+            d = lax.fori_loop(0, diag_iters, sq_once, d)
+            row = lax.dynamic_slice(a, (0, o, 0), (b, t, n))
+            row = jnp.minimum(row + jnp.minimum(
+                jnp.einsum("bij,bjk->bik", d, row,
+                           preferred_element_type=jnp.int32), 1), 1)
+            a = lax.dynamic_update_slice(a, row, (0, o, 0))
+            col = lax.dynamic_slice(a, (0, 0, o), (b, n, t))
+            col = jnp.minimum(col + jnp.minimum(
+                jnp.einsum("bij,bjk->bik", col, d,
+                           preferred_element_type=jnp.int32), 1), 1)
+            a = lax.dynamic_update_slice(a, col, (0, 0, o))
+
+            def fold(ib, a):
+                io = ib * t
+                ci = lax.dynamic_slice(col, (0, io, 0), (b, t, t))
+                ai = lax.dynamic_slice(a, (0, io, 0), (b, t, n))
+                p = jnp.einsum("bij,bjk->bik", ci, row,
+                               preferred_element_type=jnp.int32)
+                ai = jnp.minimum(ai + jnp.minimum(p, 1), 1)
+                return lax.dynamic_update_slice(a, ai, (0, io, 0))
+
+            return lax.fori_loop(0, nt, fold, a)
+
+        closed = lax.fori_loop(0, nt, pivot, a0)
+        diag = jnp.diagonal(closed, axis1=1, axis2=2)
+        return jnp.any(diag > 0, axis=1), closed
+
+    return jax.jit(closure)
+
+
 # ----------------------------------------------------- contract bindings
 # Conservative per-row resident bytes of each family's chunked carry.
 # Pure arithmetic on purpose: the graftcheck kernel-contract analyzer
@@ -430,3 +546,25 @@ def cycle_adjacency_bytes(n_nodes: int) -> int:
     body). Executed statically at CYCLE_MAX_NODES by the
     kernel-contract analyzer (lint/flow/kernel_contract.py)."""
     return 2 * n_nodes * n_nodes * 4
+
+
+def cycle_closure_tile_bytes(n_nodes: int, tile: int) -> int:
+    """Per-row resident int32 bytes of one pivot step of the blocked
+    closure (make_cycle_closure_tiled): the [T, N] row panel, the
+    [N, T] column panel, the closed [T, T] diagonal block, and one
+    [T, N] product slab from the streamed fold.  This is the
+    tile-granularity binding ISSUE 19 moves the cycle budget proof to
+    — executed statically at (CYCLE_MAX_NODES_TILED, CYCLE_TILE) by
+    the kernel-contract analyzer; the monolithic cycle_adjacency_bytes
+    binding stays for the ≤ CYCLE_MAX_NODES arm, which still ships."""
+    return (3 * tile * n_nodes + tile * tile) * 4
+
+
+def cycle_closure_tiles(n_nodes: int, tile: int) -> int:
+    """Tile-program count of one blocked-closure pass — bookkeeping for
+    the cycle_tiles_run counter (checker/schedule.py) and bench rows:
+    per pivot block one diagonal closure, N/T row-panel products, N/T
+    column-panel products, and N/T streamed fold products of N/T tiles
+    each."""
+    nt = max(1, n_nodes // max(1, tile))
+    return nt * (1 + 2 * nt + nt * nt)

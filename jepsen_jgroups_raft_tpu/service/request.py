@@ -47,7 +47,7 @@ MAX_PRIORITY = 8
 #: unit per key. "register"/"counter" accept plain single-key histories
 #: — the shape tests and the bench submit.
 def service_workloads() -> dict:
-    from ..models import CasRegister, Counter, GSet, TicketQueue
+    from ..models import CasRegister, Counter, GSet, ListAppend, TicketQueue
 
     return {
         "register": (CasRegister, False),
@@ -56,6 +56,7 @@ def service_workloads() -> dict:
         "multi-register": (CasRegister, True),
         "set": (GSet, False),
         "queue": (TicketQueue, False),
+        "list-append": (ListAppend, True),
     }
 
 
@@ -172,6 +173,16 @@ class CheckRequest:
     run_started: float = 0.0
     replayed: bool = False
     attached_to: Optional[str] = None
+    #: transactional-anomaly overlay (ISSUE 19): stamped at ADMISSION
+    #: for txn_anomaly_capable models (list-append) from the UNDECOMPOSED
+    #: multi-key histories — the per-key units cannot see cross-key
+    #: cycles, and the fingerprint hashes only per-unit encodings, so
+    #: this rides outside the result cache on purpose: a cached unit
+    #: result-set stays reusable while the overlay is recomputed per
+    #: submission (two submissions CAN share per-key encodings yet
+    #: differ in cross-key session order). The binary lane
+    #: (admit_encoded) ships encodings only, so it has no overlay.
+    txn_anomalies: Optional[dict] = None
     _done: threading.Event = field(default_factory=threading.Event)
     _finish_lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -186,10 +197,17 @@ class CheckRequest:
 
     def verdict(self):
         """Merged validity over the request's units (checker.base rule:
-        any INVALID → INVALID, else any non-VALID → UNKNOWN)."""
+        any INVALID → INVALID, else any non-VALID → UNKNOWN), folded
+        with the admission-time transactional-anomaly overlay — a
+        cross-key G0/G1c/G-single refutes the submission even when
+        every per-key unit passes its rung."""
         if self.results is None:
             return None
-        return merge_valid(r.get("valid?") for r in self.results)
+        base = merge_valid(r.get("valid?") for r in self.results)
+        if self.txn_anomalies is not None:
+            return merge_valid([base,
+                                self.txn_anomalies.get("valid?", True)])
+        return base
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request reaches a terminal state."""
@@ -235,6 +253,8 @@ class CheckRequest:
             d["attached_to"] = self.attached_to
         if self.stats:
             d["service-stats"] = dict(self.stats)
+        if self.txn_anomalies is not None:
+            d["txn-anomalies"] = self.txn_anomalies
         if include_results and self.results is not None:
             d["valid?"] = self.verdict()
             d["results"] = self.results
@@ -288,7 +308,17 @@ def admit(histories: Sequence, workload: str, algorithm: str = "auto",
     consistency = normalize_consistency(consistency)
     model, units = build_units(histories, workload)
     encs = [encode_history(h, model) for _, h in units]
-    now = time.monotonic()
+    txn = None
+    if getattr(model, "txn_anomaly_capable", False):
+        # host-only (kernel=False inside): Tarjan + numpy closure on
+        # the admission thread, never a device launch
+        from ..checker.anomaly import certify_submission
+
+        txn = certify_submission([
+            (h if isinstance(h, History) else
+             history_from_dicts(h)).client_ops()
+            for h in histories])
+    now = time.monotonic()  # admission timestamp (txn overlay above)
     deadline = now + (deadline_ms / 1000.0 if deadline_ms is not None
                       else default_deadline_s)
     return CheckRequest(
@@ -304,6 +334,7 @@ def admit(histories: Sequence, workload: str, algorithm: str = "auto",
         submitted=now,
         priority=clamp_priority(priority),
         consistency=consistency,
+        txn_anomalies=txn,
     )
 
 

@@ -11,6 +11,7 @@ from .counter import counter_workload
 from .leader import leader_workload
 from .set import set_workload
 from .queue import queue_workload
+from .listappend import listappend_workload
 
 
 def single_register(opts):
@@ -35,4 +36,5 @@ WORKLOADS = {
     "election": leader_workload,
     "set": set_workload,
     "queue": queue_workload,
+    "list-append": listappend_workload,
 }

@@ -1,0 +1,287 @@
+"""Single-process interleaved A/B: cycle tier at scale (ISSUE-19
+acceptance measurement).
+
+Four measurements, all in ONE process (cross-process comparisons
+measure the host's mood), verdict identity asserted BEFORE anything is
+timed:
+
+  1. **closure-kernel** — the blocked transitive-closure kernel
+     (ops/kernel_ir.make_cycle_closure_tiled) vs the host DFS at
+     N ∈ {1024, 2048}: has-cycle flags must agree on every seeded
+     graph (dense-ish digraphs, DAGs, and a long planted cycle — the
+     shape tiling could silently lose), then both arms are timed
+     interleaved with order rotation. The speedup is reported, not
+     gated: the measured-routing stance keeps the DFS wherever matmul
+     is not effectively free, and on a CPU host the DFS wins — the
+     point of the row is that the kernel now DECIDES these buckets at
+     all (the 512-cap tier skipped them) and agrees bit for bit.
+  2. **condensation** — certify_history with the Tarjan condensation
+     pre-pass on vs off (JGRAFT_CYCLE_CONDENSE=0) at the north-star
+     transactional shape: a multi-key list-append history whose graph
+     is a few thousand nodes with rw edges everywhere, so the direct
+     arm pays the G-single reachability closure while the condense arm
+     answers from the SCC structure alone. Acceptance bar: ≥ 1.3×.
+  3. **ablation identity** — JGRAFT_CYCLE_TILE=0 / CONDENSE=0
+     reproduce the default arms' verdicts at N ≤ 512 through the
+     production find_cycles entry (witnesses are validated as genuine
+     cycles in both arms), and the anomaly classes (G0/G1c/G-single)
+     certify identically condensed vs direct on the planted fixtures.
+  4. **anomaly rung** — the seeded cross-key G1c is refuted with a
+     witness exactly where the per-key relaxation rungs cannot see it:
+     every single-key projection passes the per-key sequential rung.
+
+Also exercised: the size-skip contract — with the tiled kernel
+disabled the node cap falls back to 512 and the north-star history is
+stamped "cycle-skipped-size" (UNKNOWN), while the default arm decides
+it outright.
+
+Usage: python scripts/ab_cycle.py [--reps 3] [--sizes 1024,2048]
+       [--n-ops 2000] [--n-keys 24] [--batch 2]
+"""
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _serial_listappend_rows(rng, n_ops: int, n_keys: int, n_procs: int,
+                            read_p: float = 0.55):
+    """A clean (serializable-by-construction) multi-key list-append
+    history: serial keyed ops round-robined over processes. Reads
+    observe prefixes that later appends extend, so rw edges abound —
+    the shape where the direct arm must pay for a closure and the
+    condensation arm must not."""
+    state = {k: [] for k in range(n_keys)}
+    next_elem = {k: 1 for k in range(n_keys)}
+    rows = []
+    for i in range(n_ops):
+        p = i % n_procs
+        k = rng.randrange(n_keys)
+        if next_elem[k] <= 31 and rng.random() > read_p:
+            e = next_elem[k]
+            next_elem[k] += 1
+            state[k] = state[k] + [e]
+            rows.append((p, "invoke", "append", (k, e)))
+            rows.append((p, "ok", "append", (k, list(state[k]))))
+        else:
+            rows.append((p, "invoke", "read", (k, None)))
+            rows.append((p, "ok", "read", (k, list(state[k]))))
+    return rows
+
+
+def _g1c_rows():
+    """The seeded cross-key G1c: each session reads the OTHER key's
+    append before its own append lands — wr/po edges close a cross-key
+    cycle while both single-key projections stay sequential."""
+    return [
+        (1, "invoke", "read", ("y", None)), (1, "ok", "read", ("y", [1])),
+        (1, "invoke", "append", ("x", 1)), (1, "ok", "append", ("x", [1])),
+        (2, "invoke", "read", ("x", None)), (2, "ok", "read", ("x", [1])),
+        (2, "invoke", "append", ("y", 1)), (2, "ok", "append", ("y", [1])),
+    ]
+
+
+def _is_cycle(witness, adj_of) -> bool:
+    """Every consecutive witness pair (wrapping) is a real edge."""
+    if not witness:
+        return False
+    n = len(witness)
+    return all(adj_of(witness[i], witness[(i + 1) % n]) for i in range(n))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--sizes", default="1024,2048")
+    ap.add_argument("--n-ops", type=int, default=2000,
+                    help="north-star transactional history length")
+    ap.add_argument("--n-keys", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=2,
+                    help="graphs per size in the kernel A/B")
+    args = ap.parse_args()
+
+    import random
+
+    import numpy as np
+
+    from jepsen_jgroups_raft_tpu.checker.anomaly import certify_history
+    from jepsen_jgroups_raft_tpu.checker.cycle import (find_cycles,
+                                                       host_has_cycle)
+    from jepsen_jgroups_raft_tpu.checker.linearizable import check_histories
+    from jepsen_jgroups_raft_tpu.history.packing import encode_history
+    from jepsen_jgroups_raft_tpu.history.synth import (build_history,
+                                                       corrupt,
+                                                       random_valid_history)
+    from jepsen_jgroups_raft_tpu.models import CasRegister
+    from jepsen_jgroups_raft_tpu.models.listappend import ListAppend
+    from jepsen_jgroups_raft_tpu.ops.kernel_ir import (
+        CYCLE_TILE, cycle_closure_tile, make_cycle_closure_tiled)
+
+    for k in ("JGRAFT_CYCLE_CONDENSE", "JGRAFT_CYCLE_TILE",
+              "JGRAFT_CYCLE_KERNEL", "JGRAFT_CYCLE_MAX_OPS"):
+        os.environ.pop(k, None)
+    overall_ok = True
+
+    # ------------------------------------------- 1. closure kernel A/B
+    rng = random.Random(19)
+    for N in (int(s) for s in args.sizes.split(",")):
+        nrng = np.random.default_rng(N)
+        graphs = []
+        for b in range(args.batch):
+            g = (nrng.random((N, N)) < 3.0 / N).astype(np.int32)
+            np.fill_diagonal(g, 0)
+            if b % 2 == 0:
+                g = np.triu(g, 1)  # a DAG arm per size
+            else:
+                for i in range(N - 1):  # a planted Hamiltonian cycle
+                    g[i, i + 1] = 1
+                g[N - 1, 0] = 1
+            graphs.append(g)
+        t = cycle_closure_tile(N, CYCLE_TILE)
+        kfn = make_cycle_closure_tiled(N, t)
+        batch = np.stack(graphs)
+
+        def run_kernel():
+            t0 = time.perf_counter()
+            has, _closed = kfn(batch)
+            flags = [bool(v) for v in np.asarray(has)]
+            return time.perf_counter() - t0, flags
+
+        def run_dfs():
+            t0 = time.perf_counter()
+            flags = [host_has_cycle(g) for g in graphs]
+            return time.perf_counter() - t0, flags
+
+        # warm-up (compile) + verdict-identity gate BEFORE timing
+        _, flags_k = run_kernel()
+        _, flags_d = run_dfs()
+        assert flags_k == flags_d, f"N={N}: kernel/DFS flags diverge"
+        assert True in flags_d and False in flags_d, f"N={N}: one polarity"
+
+        variants = [("tiled-kernel", run_kernel), ("host-dfs", run_dfs)]
+        times = {name: [] for name, _ in variants}
+        for rep in range(args.reps):          # interleaved, order rotated
+            order = variants if rep % 2 == 0 else variants[::-1]
+            for name, fn in order:
+                times[name].append(fn()[0])
+        for name, ts in times.items():
+            print({"section": "closure-kernel", "N": N, "tile": t,
+                   "variant": name, "min_s": round(min(ts), 4),
+                   "median_s": round(statistics.median(ts), 4)})
+        print({"section": "closure-kernel", "N": N,
+               "graphs": len(graphs), "verdicts_identical": True,
+               "dfs_over_kernel_at_min":
+               round(min(times["host-dfs"]) / min(times["tiled-kernel"]), 3)})
+
+    # -------------------------------------- 2. condensation A/B (bar)
+    rng = random.Random(23)
+    star = build_history(_serial_listappend_rows(rng, args.n_ops,
+                                                 args.n_keys, 8))
+    planted = build_history(_g1c_rows())
+
+    def run_certify(condense: bool):
+        os.environ["JGRAFT_CYCLE_CONDENSE"] = "1" if condense else "0"
+        t0 = time.perf_counter()
+        r = certify_history(star, kernel=False)
+        return time.perf_counter() - t0, r
+
+    _, r_on = run_certify(True)
+    _, r_off = run_certify(False)
+    assert r_on["valid?"] is True and r_off["valid?"] is True, \
+        "north-star shape must certify clean on both arms"
+    assert r_on["nodes"] == r_off["nodes"] >= args.n_ops // 2
+    for condense in (True, False):
+        os.environ["JGRAFT_CYCLE_CONDENSE"] = "1" if condense else "0"
+        rp = certify_history(planted, kernel=False)
+        assert rp["valid?"] is False and "G1c" in rp["anomalies"], condense
+
+    times = {"condense-on": [], "condense-off": []}
+    pairs = [("condense-on", True), ("condense-off", False)]
+    for rep in range(args.reps):
+        order = pairs if rep % 2 == 0 else pairs[::-1]
+        for name, condense in order:
+            times[name].append(run_certify(condense)[0])
+    os.environ.pop("JGRAFT_CYCLE_CONDENSE", None)
+    speedup = min(times["condense-off"]) / min(times["condense-on"])
+    print({"section": "condensation", "nodes": r_on["nodes"],
+           "on_min_s": round(min(times["condense-on"]), 4),
+           "off_min_s": round(min(times["condense-off"]), 4),
+           "speedup_at_min": round(speedup, 3),
+           "acceptance_condense_1_3x": speedup >= 1.3})
+    overall_ok &= speedup >= 1.3
+
+    # ---------------------- size-skip contract: TILE=0 cap vs default
+    os.environ["JGRAFT_CYCLE_TILE"] = "0"
+    skipped = certify_history(star, kernel=False)
+    os.environ.pop("JGRAFT_CYCLE_TILE", None)
+    decided = certify_history(star, kernel=False)
+    assert skipped["valid?"] == "unknown" and \
+        skipped.get("cycle-skipped-size", 0) > 512, skipped
+    assert decided["valid?"] is True
+    print({"section": "size-skip", "tile0_valid": skipped["valid?"],
+           "tile0_skipped_size": skipped["cycle-skipped-size"],
+           "default_valid": decided["valid?"],
+           "decided_where_cap_skips": True})
+
+    # --------------------------- 3. ablation identity at N <= 512
+    rng = random.Random(29)
+    m = CasRegister()
+    hists = []
+    for i in range(24):
+        h = random_valid_history(rng, "register", n_ops=48, n_procs=4,
+                                 crash_p=0.1, max_crashes=2)
+        hists.append(corrupt(rng, h) if i % 3 == 0 else h)
+    # a guaranteed cycle-refuted row (same-process stale read), so both
+    # polarities are exercised regardless of what corrupt() perturbed
+    hists.append(build_history([
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "read", None), (0, "ok", "read", None),
+    ]))
+    encs = [encode_history(h, m) for h in hists]
+
+    def cycle_results():
+        return find_cycles(encs, m)
+
+    base = cycle_results()
+    os.environ["JGRAFT_CYCLE_TILE"] = "0"
+    os.environ["JGRAFT_CYCLE_CONDENSE"] = "0"
+    ablated = cycle_results()
+    for k in ("JGRAFT_CYCLE_TILE", "JGRAFT_CYCLE_CONDENSE"):
+        os.environ.pop(k, None)
+    verdicts_b = [c is None for c in base]
+    verdicts_a = [c is None for c in ablated]
+    assert verdicts_b == verdicts_a, "ablation arms diverge at N<=512"
+    assert True in verdicts_b and False in verdicts_b
+    refuted = sum(1 for v in verdicts_b if not v)
+    print({"section": "ablation", "rows": len(hists), "refuted": refuted,
+           "verdicts_identical": True})
+
+    # -------------------------------- 4. the rung relaxation cannot see
+    by_key: dict = {}
+    for p, typ, f, v in _g1c_rows():
+        k, payload = v
+        by_key.setdefault(k, []).append((p, typ, f, payload))
+    per_key_valid = {}
+    for k, rows in sorted(by_key.items()):
+        h = build_history(rows)
+        [res] = check_histories([h], ListAppend(), algorithm="jax",
+                                consistency="sequential")
+        per_key_valid[k] = res["valid?"]
+    anom = certify_history(planted, kernel=False)
+    g1c = anom["anomalies"].get("G1c")
+    rung_ok = (all(v is True for v in per_key_valid.values())
+               and anom["valid?"] is False and g1c is not None
+               and bool(g1c.get("cycle")))
+    print({"section": "anomaly-rung", "per_key_sequential": per_key_valid,
+           "txn_valid": anom["valid?"],
+           "g1c_witness": g1c, "acceptance_refuted_beyond_rungs": rung_ok})
+    overall_ok &= rung_ok
+
+    print({"acceptance_all": overall_ok})
+
+
+if __name__ == "__main__":
+    main()

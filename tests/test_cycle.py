@@ -262,6 +262,9 @@ def test_sc_refutation_where_session_rung_passes():
 
 
 def test_find_cycles_respects_node_cap(monkeypatch):
+    from jepsen_jgroups_raft_tpu.checker.schedule import (consume_stats,
+                                                          stats_scope)
+
     monkeypatch.setenv("JGRAFT_CYCLE_MAX_OPS", "2")
     m = CasRegister()
     h = H(  # 3 required ops > cap → tier skipped (sound: only moves work)
@@ -269,11 +272,16 @@ def test_find_cycles_respects_node_cap(monkeypatch):
         (0, "invoke", "write", 2), (0, "ok", "write", 2),
         (0, "invoke", "read", None), (0, "ok", "read", 1),
     )
-    [c] = find_cycles([encode_history(h, m)], m)
-    assert c is None
+    consume_stats()
+    with stats_scope() as scope:
+        [c] = find_cycles([encode_history(h, m)], m)
+    # ISSUE 19 satellite: the cap skip is no longer silent — the row
+    # carries a marker (never a cycle) and the scheduler counts it
+    assert c == {"skipped-size": 3}
+    assert scope["cycle_size_skips"] == 1
     monkeypatch.delenv("JGRAFT_CYCLE_MAX_OPS")
     [c2] = find_cycles([encode_history(h, m)], m)
-    assert c2 is not None  # uncapped, the stale read cycles
+    assert c2 is not None and "cycle" in c2  # uncapped: stale read cycles
 
 
 # ------------------------------------------------------- tier counters

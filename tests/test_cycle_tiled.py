@@ -1,0 +1,218 @@
+"""Blocked transitive-closure kernel + SCC condensation (ISSUE 19):
+tiled ≡ monolithic ≡ host DFS differentials (512 boundary and the
+513-crossing bucket the monolithic cap skips), condensation ≡ direct
+verdict identity, tile clamping, the tile-granularity VMEM binding
+twin, and the scope counters the perf surface reads.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_jgroups_raft_tpu.checker.cycle import (find_cycles,
+                                                   host_has_cycle,
+                                                   tarjan_scc)
+from jepsen_jgroups_raft_tpu.checker.schedule import (consume_stats,
+                                                      stats_scope)
+from jepsen_jgroups_raft_tpu.history.packing import encode_history
+from jepsen_jgroups_raft_tpu.models import CasRegister
+from jepsen_jgroups_raft_tpu.ops.kernel_ir import (CYCLE_MAX_NODES,
+                                                   CYCLE_MAX_NODES_TILED,
+                                                   CYCLE_TILE,
+                                                   cycle_closure_tile,
+                                                   cycle_closure_tile_bytes,
+                                                   cycle_closure_tiles,
+                                                   make_cycle_closure,
+                                                   make_cycle_closure_tiled)
+
+from util import H, corrupt, random_valid_history
+
+
+def _random_digraph(rng: random.Random, n: int, p: float) -> np.ndarray:
+    adj = (np.asarray([[rng.random() for _ in range(n)]
+                       for _ in range(n)]) < p).astype(np.uint8)
+    np.fill_diagonal(adj, 0)
+    return adj
+
+
+def _random_dag(rng: random.Random, n: int, p: float) -> np.ndarray:
+    adj = _random_digraph(rng, n, p)
+    return np.triu(adj, 1)
+
+
+# ------------------------------------------------ kernel differentials
+
+
+def test_tiled_matches_monolithic_and_dfs_small():
+    """Tiled and monolithic closures agree bit for bit with each other
+    and with the host DFS across sizes, tiles, and densities — both
+    the has-cycle flags and the full closed matrices."""
+    rng = random.Random(7)
+    for n, t in ((4, 2), (8, 4), (16, 4), (32, 8), (64, 16)):
+        graphs = [_random_digraph(rng, n, p) for p in (0.05, 0.3)]
+        graphs += [_random_dag(rng, n, 0.4)]
+        batch = np.stack([g.astype(np.int32) for g in graphs])
+        has_m, closed_m = make_cycle_closure(n)(batch)
+        has_t, closed_t = make_cycle_closure_tiled(n, t)(batch)
+        assert np.array_equal(np.asarray(has_m), np.asarray(has_t)), (n, t)
+        assert np.array_equal(np.asarray(closed_m),
+                              np.asarray(closed_t)), (n, t)
+        for k, g in enumerate(graphs):
+            assert bool(np.asarray(has_t)[k]) is host_has_cycle(g), (n, k)
+
+
+def test_tiled_long_chain_closure_is_complete():
+    """A single Hamiltonian path exercises paths that cross every tile
+    boundary: closure must connect i → j for all i < j and nothing
+    else (the completeness direction tiling could silently lose)."""
+    n, t = 32, 8
+    adj = np.zeros((n, n), dtype=np.int32)
+    for i in range(n - 1):
+        adj[i, i + 1] = 1
+    has, closed = make_cycle_closure_tiled(n, t)(adj[None])
+    assert not bool(np.asarray(has)[0])
+    expect = np.triu(np.ones((n, n), dtype=np.int32), 1)
+    assert np.array_equal(np.asarray(closed)[0], expect)
+
+
+@pytest.mark.slow
+def test_tiled_decides_the_bucket_the_monolithic_cap_skips():
+    """512-boundary and 513-crossing: at N = 512 tiled ≡ monolithic;
+    at the first post-cap bucket (a 513-node graph padded to its
+    bucket) the tiled kernel agrees with the host DFS — the rows the
+    512-cap tier skips today."""
+    from jepsen_jgroups_raft_tpu.history.packing import bucket_rows
+
+    rng = random.Random(11)
+    # boundary: N = 512 exactly (monolithic still proven there)
+    g512 = _random_dag(rng, CYCLE_MAX_NODES, 6.0 / CYCLE_MAX_NODES)
+    b = g512.astype(np.int32)[None]
+    has_m, closed_m = make_cycle_closure(CYCLE_MAX_NODES)(b)
+    t512 = cycle_closure_tile(CYCLE_MAX_NODES, CYCLE_TILE)
+    has_t, closed_t = make_cycle_closure_tiled(CYCLE_MAX_NODES, t512)(b)
+    assert np.array_equal(np.asarray(has_m), np.asarray(has_t))
+    assert np.array_equal(np.asarray(closed_m), np.asarray(closed_t))
+    # crossing: 513 real nodes, padded to the next bucket
+    n_real = CYCLE_MAX_NODES + 1
+    N = bucket_rows(n_real, 4)
+    assert N > CYCLE_MAX_NODES
+    t = cycle_closure_tile(N, CYCLE_TILE)
+    assert N % t == 0
+    for cyclic in (False, True):
+        g = _random_dag(rng, n_real, 4.0 / n_real)
+        if cyclic:
+            g[n_real - 1, 0] = 1  # close a long cycle
+            g[0, 1] = 1
+            for i in range(1, n_real - 1):
+                g[i, i + 1] = 1
+        padded = np.zeros((1, N, N), dtype=np.int32)
+        padded[0, :n_real, :n_real] = g
+        has, closed = make_cycle_closure_tiled(N, t)(padded)
+        assert bool(np.asarray(has)[0]) is host_has_cycle(g), cyclic
+        assert host_has_cycle(g) is cyclic
+
+
+def test_tile_clamp_and_validation():
+    """cycle_closure_tile returns the largest pow2 ≤ tile dividing N
+    (midpoint buckets like 768 = 3·256 admit 256); the tiled factory
+    rejects non-dividing tiles loudly."""
+    assert cycle_closure_tile(768, 256) == 256
+    assert cycle_closure_tile(512, 256) == 256
+    assert cycle_closure_tile(96, 256) == 32
+    assert cycle_closure_tile(6, 4) == 2
+    assert cycle_closure_tile(7, 4) == 1
+    with pytest.raises(ValueError):
+        make_cycle_closure_tiled(10, 4)
+
+
+def test_tile_bytes_binding_twin():
+    """Runtime twin of the kernel-contract tile binding: the per-tile
+    slab fits VMEM at the tiled cap with the default tile, and the
+    tile count accounting is exact for the pivot/panel/fold schedule."""
+    assert cycle_closure_tile_bytes(CYCLE_MAX_NODES_TILED,
+                                    CYCLE_TILE) <= 16 << 20
+    assert cycle_closure_tile_bytes(1024, CYCLE_TILE) <= 16 << 20
+    # nt pivots, each: 1 diagonal + 2 panel updates + nt fold panels
+    nt = 1024 // 256
+    assert cycle_closure_tiles(1024, 256) == nt * (1 + 2 * nt + nt * nt)
+
+
+# --------------------------------------------------------- condensation
+
+
+def test_tarjan_matches_dfs_cycle_oracle():
+    """Non-trivial SCC ⇔ host DFS cycle, over seeded graphs of both
+    polarities; components partition the nodes."""
+    rng = random.Random(13)
+    seen = {True: 0, False: 0}
+    for _ in range(40):
+        n = rng.randrange(2, 24)
+        g = (_random_digraph(rng, n, 0.15) if rng.random() < 0.5
+             else _random_dag(rng, n, 0.4))
+        comps = tarjan_scc(g)
+        assert sorted(v for c in comps for v in c) == list(range(n))
+        nontrivial = any(len(c) >= 2 for c in comps)
+        has = host_has_cycle(g)
+        # self-loops are zeroed by graph construction; these random
+        # graphs have none, so the equivalence is exact
+        assert nontrivial is has
+        seen[has] += 1
+    assert seen[True] and seen[False]
+
+
+def test_condense_and_direct_arms_agree(monkeypatch):
+    """JGRAFT_CYCLE_CONDENSE=0 (the ablation identity acceptance row):
+    verdicts through the production find_cycles entry are identical
+    with condensation forced off, across both polarities."""
+    rng = random.Random(17)
+    m = CasRegister()
+    hists = []
+    for i in range(12):
+        h = random_valid_history(rng, "register", n_ops=16, n_procs=3,
+                                 crash_p=0.15)
+        if i % 3 == 0:
+            h = corrupt(rng, h)
+        hists.append(h)
+    # a guaranteed cycle-refuted row (same-process stale read), so both
+    # polarities are always exercised regardless of what corrupt() hit
+    hists.append(H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "read", None), (0, "ok", "read", None),
+    ))
+    encs = [encode_history(h, m) for h in hists]
+
+    def verdicts():
+        return [(c is None, None if c is None else sorted(c.get("cycle")))
+                for c in find_cycles(encs, m)]
+
+    on = verdicts()
+    monkeypatch.setenv("JGRAFT_CYCLE_CONDENSE", "0")
+    off = verdicts()
+    assert [v for v, _ in on] == [v for v, _ in off]
+    assert True in [v for v, _ in on] and False in [v for v, _ in on]
+
+
+def test_condensation_counters_reach_the_scope(monkeypatch):
+    """The size-skip, pre/post-condensation node and scc-hit counters
+    land in the thread-affine scan scope (the fields perf.py and the
+    bench rows surface)."""
+    monkeypatch.delenv("JGRAFT_CYCLE_CONDENSE", raising=False)
+    consume_stats()  # drain totals earlier tests accumulated
+    m = CasRegister()
+    # same-process stale read: a guaranteed 2-cycle
+    h = H(
+        (0, "invoke", "write", 1), (0, "ok", "write", 1),
+        (0, "invoke", "read", None), (0, "ok", "read", None),
+    )
+    encs = [encode_history(h, m)]
+    with stats_scope():
+        [c] = find_cycles(encs, m)
+        scope = consume_stats()
+    assert c is not None and "cycle" in c
+    assert scope["cycle_nodes_pre"] >= 2
+    assert scope["cycle_nodes_post"] >= 1
+    assert scope["cycle_scc_hits"] >= 1
+    assert scope["cycle_size_skips"] == 0
