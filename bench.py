@@ -1040,6 +1040,69 @@ def run_suite(platform_note: str) -> None:
     timed("9: list-append 1000x1k", ListAppend(), hs)
 
 
+def run_search(platform_note: str) -> None:
+    """ISSUE-20 scenario-search mode (`python bench.py --search`): run
+    the seeded-violation recall harness (graftsearch) and report recall,
+    recall per CPU-minute, generations, corpus size, and the fitness
+    distribution. Shape comes from the JGRAFT_SEARCH_* knobs
+    (doc/running.md) plus JGRAFT_SEARCH_PLANTS for K. Two reps with the
+    cold/warm split: the cold rep pays XLA compiles for whatever shape
+    buckets the mutants coalesce into, the warm rep is the comparable
+    number (same discipline as every other row — host absolute numbers
+    drift, so cross-host comparisons use `scripts/ab_search.py`'s
+    same-process interleaved arms instead)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from jepsen_jgroups_raft_tpu.search.driver import search_config_from_env
+    from jepsen_jgroups_raft_tpu.search.recall import (plant_violations,
+                                                      run_recall)
+
+    k = env_int("JGRAFT_SEARCH_PLANTS", 20, minimum=1)
+    t0 = time.time()
+    cfg = search_config_from_env(corpus_dir=tempfile.mkdtemp(
+        prefix="graftsearch-bench-"))
+    try:
+        plants = plant_violations(cfg, k)
+        reps = []
+        for rep in range(2):  # rep 0 cold (compiles), rep 1 warm
+            shutil.rmtree(cfg.corpus_dir, ignore_errors=True)
+            reps.append(run_recall(cfg, plants=plants))
+        cold, warm = reps
+        if cold.report["corpus-fingerprints"] != \
+                warm.report["corpus-fingerprints"]:
+            fail("search corpus not deterministic across reps")
+            return
+        rep = warm.report
+        emit({
+            "metric": "search_recall",
+            "value": warm.recall,
+            "unit": "fraction",
+            "arm": rep["arm"],
+            "planted": warm.planted,
+            "found": len(warm.found),
+            "missed": len(warm.missed),
+            "recall_per_cpu_min": round(warm.recall_per_cpu_min, 4),
+            "generations": rep["generations"],
+            "candidates": rep["candidates"],
+            "corpus_entries": rep["corpus"],
+            "unconfirmed": rep["unconfirmed"],
+            "fitness": rep["fitness"],
+            "families": rep["families"],
+            "seed": rep["seed"],
+            "cold_rep_cpu_s": round(cold.cpu_s, 3),
+            "warm_rep_cpu_s": round(warm.cpu_s, 3),
+            "time_s": round(time.time() - t0, 3),
+            "platform": jax.devices()[0].platform,
+            "platform_note": platform_note,
+            "host_fingerprint": host_fingerprint(),
+        })
+    finally:
+        shutil.rmtree(cfg.corpus_dir, ignore_errors=True)
+
+
 def run_service(platform_note: str) -> None:
     """ISSUE-5 service throughput mode (`python bench.py --service`):
     drive graftd over its real HTTP surface with sustained concurrent
@@ -2000,6 +2063,10 @@ def main() -> None:
     if "--suite" in sys.argv:
         run_suite(note)
         persist_artifact("suite")
+        return
+    if "--search" in sys.argv:
+        run_search(note)
+        persist_artifact("search")
         return
     if "--service" in sys.argv:
         run_service(note)

@@ -265,6 +265,44 @@ def cmd_check(args) -> int:
     return 0 if summary["valid?"] is True else 1
 
 
+def cmd_search(args) -> int:
+    """graftsearch (ISSUE 20): coverage-guided scenario search. Default
+    mode runs the open-ended generation loop and prints the run report;
+    --recall K plants K known violations first and reports
+    found-vs-missed per CPU-minute."""
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    from .search.driver import SearchDriver, search_config_from_env
+    from .search.recall import run_recall
+
+    overrides = {}
+    for flag, key in (("population", "population"),
+                      ("generations", "generations"),
+                      ("survivors", "survivors"),
+                      ("edit_space", "edit_space"),
+                      ("seed", "seed"),
+                      ("corpus_dir", "corpus_dir")):
+        v = getattr(args, flag)
+        if v is not None:
+            overrides[key] = v
+    if args.arm is not None:
+        overrides["guided"] = args.arm == "guided"
+    overrides["families"] = tuple(
+        f.strip() for f in args.families.split(",") if f.strip())
+    overrides["consistency"] = args.consistency
+    overrides["n_ops"] = args.n_ops
+    if args.service_url:
+        overrides["service_url"] = args.service_url
+    cfg = search_config_from_env(**overrides)
+    if args.recall:
+        rep = run_recall(cfg, k=args.recall).to_dict()
+    else:
+        rep = SearchDriver(cfg).run()
+    print(json.dumps(rep, indent=2, default=str))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="jepsen_jgroups_raft_tpu",
@@ -307,6 +345,46 @@ def main(argv=None) -> int:
                          "it stable across restarts so the replica "
                          "replays its own journal)")
     sc.set_defaults(fn=cmd_serve_checker)
+    se = sub.add_parser(
+        "search",
+        help="graftsearch: coverage-guided scenario search over graftd "
+             "(mutation registry + verdict-signal fitness + minimized "
+             "corpus under store/search/)")
+    se.add_argument("--families",
+                    default="register,set,queue,list-append",
+                    help="comma-separated model families to search")
+    se.add_argument("--population", type=int, default=None,
+                    help="candidates per generation "
+                         "(default: JGRAFT_SEARCH_POP or 48)")
+    se.add_argument("--generations", type=int, default=None,
+                    help="default: JGRAFT_SEARCH_GENERATIONS or 8")
+    se.add_argument("--survivors", type=int, default=None,
+                    help="survivor pool size "
+                         "(default: JGRAFT_SEARCH_SURVIVORS or 12)")
+    se.add_argument("--edit-space", type=int, default=None,
+                    help="mutation edit-seed space "
+                         "(default: JGRAFT_SEARCH_EDIT_SPACE or 24)")
+    se.add_argument("--seed", type=int, default=None,
+                    help="run seed (default: JGRAFT_SEARCH_SEED or 0); "
+                         "same seed => identical corpus fingerprints")
+    se.add_argument("--corpus-dir", default=None,
+                    help="corpus root (default: JGRAFT_SEARCH_DIR or "
+                         "store/search)")
+    se.add_argument("--arm", choices=["guided", "random"], default=None,
+                    help="override JGRAFT_SEARCH_GUIDED (random = the "
+                         "blind-mutation ablation arm)")
+    se.add_argument("--consistency", default="linearizable")
+    se.add_argument("--n-ops", type=int, default=20,
+                    help="base-history length per scenario")
+    se.add_argument("--recall", type=int, default=None, metavar="K",
+                    help="plant K known violations and report recall "
+                         "per CPU-minute instead of open-ended search")
+    se.add_argument("--service-url", default=None,
+                    help="evaluate through a running graftd daemon "
+                         "(binary frames for non-transactional "
+                         "workloads); default: in-process service")
+    se.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    se.set_defaults(fn=cmd_search)
     c = sub.add_parser("check",
                        help="re-verify recorded runs as one device batch")
     c.add_argument("paths", nargs="+",

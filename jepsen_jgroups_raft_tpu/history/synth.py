@@ -204,25 +204,16 @@ def random_valid_history(
 
 
 def corrupt(rng: random.Random, hist: History) -> History:
-    """Randomly perturb one completion value (may or may not break
-    linearizability — the oracle decides)."""
-    rows = [(o.process, o.type, o.f, o.value) for o in hist]
-    idxs = [i for i, r in enumerate(rows) if r[1] == OK]
-    if not idxs:
-        return hist
-    i = rng.choice(idxs)
-    p, t, f, v = rows[i]
-    if f == "read" and isinstance(v, list):
-        # set membership read: drop an observed element or claim one
-        v = v[1:] if v else [rng.randrange(4)]
-    elif f in ("read",):
-        v = (v if isinstance(v, int) and v is not None else 0) + rng.choice([1, -1])
-    elif f == "add-and-get" and v is not None:
-        v = (v[0], v[1] + rng.choice([1, -1]))
-    elif f in ("enqueue", "dequeue"):
-        # perturb the observed ticket (an empty dequeue claims one)
-        v = (v + 1) if isinstance(v, int) else 0
-    elif f == "write":
-        pass  # write completions carry the written value; leave
-    rows[i] = (p, t, f, v)
-    return build_history(rows)
+    """Randomly perturb one completion (may or may not break
+    linearizability — the oracle decides). Thin compat wrapper over the
+    typed operator registry (`search/operators.py`, ISSUE 20), which
+    fixed this function's two blind spots: the write arm used to be a
+    silent no-op (completed writes echo the written value, so a sound
+    perturbation must rewrite the invocation too) and list-append
+    observed lists were never perturbed at all. Every model family now
+    has at least one operator that can flip a seeded-valid history to
+    invalid. Imported lazily: search composes on top of synth, not the
+    other way around."""
+    from ..search.operators import corrupt_once
+
+    return corrupt_once(rng, hist)

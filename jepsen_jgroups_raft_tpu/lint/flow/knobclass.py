@@ -108,6 +108,11 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_ROUTE_MIN_CELLS": ROUTING,
     "JGRAFT_SCAN_CHUNK": ROUTING,
     "JGRAFT_SCAN_UNROLL": ROUTING,
+    # search-arm knobs route which CANDIDATES get generated/checked
+    # (guided vs random parent/operator draw, mutation edit-seed
+    # space); no knob touches how any candidate's verdict is computed
+    "JGRAFT_SEARCH_EDIT_SPACE": ROUTING,
+    "JGRAFT_SEARCH_GUIDED": ROUTING,
     "JGRAFT_SEGMENT": ROUTING,
     "JGRAFT_SERVICE_BATCH_WAIT_MS": ROUTING,
     "JGRAFT_SERVICE_MAX_BATCH_ROWS": ROUTING,
@@ -137,6 +142,12 @@ KNOB_CLASS: Dict[str, str] = {
     "JGRAFT_CLUSTER_TTL_S": OPS,
     "JGRAFT_DISTRIBUTED_TIMEOUT_MS": OPS,
     "JGRAFT_PROFILE_DIR": OPS,
+    "JGRAFT_SEARCH_DIR": OPS,
+    "JGRAFT_SEARCH_GENERATIONS": OPS,
+    "JGRAFT_SEARCH_PLANTS": OPS,
+    "JGRAFT_SEARCH_POP": OPS,
+    "JGRAFT_SEARCH_SEED": OPS,
+    "JGRAFT_SEARCH_SURVIVORS": OPS,
     "JGRAFT_SERVICE_ADVERTISE_URL": OPS,
     "JGRAFT_SERVICE_BENCH_CLIENTS": OPS,
     "JGRAFT_SERVICE_BENCH_FASTLANE": OPS,

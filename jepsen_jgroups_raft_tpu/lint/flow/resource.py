@@ -78,8 +78,11 @@ SCAN_FILES = ("deploy/ssh.py", "deploy/local.py", "core/runner.py",
 #: workload/ rides along since the scenario tier (ISSUE 10): its
 #: set/queue clients own real connections behind CAS retry loops — an
 #: exception path that drops one mid-loop is the leak class this rule
-#: exists for.
-SCAN_PREFIXES = ("service/", "workload/")
+#: exists for. search/ (ISSUE 20) rides along: the driver owns a whole
+#: CheckingService (worker threads) plus corpus temp files — a search
+#: that leaks its daemon on an exception path wedges the next run's
+#: admission.
+SCAN_PREFIXES = ("service/", "workload/", "search/")
 
 
 def applies_to(relpath: str) -> bool:

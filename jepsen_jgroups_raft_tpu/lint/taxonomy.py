@@ -75,8 +75,11 @@ LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
 #: are scanned like the service tier — a broad except around a verdict
 #: path is exactly where an indefinite error could turn into a wrong
 #: "valid".
+#: search/ (ISSUE 20) is scanned like the service tier: a swallowed
+#: error in evaluation or archive would silently count a candidate as
+#: boring (fitness 0) or drop a violation — recall numbers that lie.
 SCAN_PREFIXES = ("client/", "workload/", "deploy/", "service/",
-                 "generator/")
+                 "generator/", "search/")
 SCAN_FILES = ("core/runner.py", "native/client.py", "core/serve.py",
               "parallel/distributed.py", "parallel/launch.py",
               "scripts/chaos_graftd.py", "checker/set_queue.py",

@@ -64,6 +64,36 @@ def parse_nemesis_spec(spec) -> tuple:
     return faults
 
 
+#: per-fault completion-ambiguity pressure at the reference interval
+#: (5s): how strongly each fault turns live client ops into crashed
+#: (info) ops in a recorded history. kill/partition sever clients
+#: mid-op; pause merely delays; schedules churn specific workloads.
+_FAULT_PRESSURE = {
+    "kill": 0.08,
+    "partition": 0.06,
+    "pause": 0.03,
+    "member": 0.05,
+    "set-churn": 0.08,
+    "queue-drain": 0.06,
+}
+
+
+def schedule_pressure(spec, interval: float) -> dict:
+    """Map a nemesis spec + firing interval onto synthetic-history
+    pressure (ISSUE 20): offline scenario search can't run the live
+    fault injectors, but the *observable* effect of a schedule on a
+    history is its crashed-op density — each fault contributes its
+    reference pressure scaled by firing rate (5.0/interval), capped so
+    the generator's concurrency window stays checkable. Returns
+    {"crash_bias": float, "crash_burst": int} for
+    `history/synth.random_valid_history`'s crash_p / max_crashes."""
+    faults = parse_nemesis_spec(spec)
+    rate = 5.0 / max(0.5, float(interval))
+    bias = sum(_FAULT_PRESSURE.get(f, 0.04) for f in faults) * rate
+    return {"crash_bias": round(min(0.4, bias), 4),
+            "crash_burst": min(4, len(faults))}
+
+
 @dataclass
 class Package:
     """One fault's bundle (jepsen.nemesis.combined package map)."""
